@@ -1,0 +1,221 @@
+"""LLMEngine: the single-host serving engine (continuous batching over jit).
+
+This is the component the reference delegated wholesale to vLLM CUDA images
+(SURVEY §0 consequence 2). Responsibilities:
+
+- owns model params, the paged KV cache (donated through every step so XLA
+  updates it in place), and the scheduler;
+- compiles one XLA program per (kind, bucketed shape) and reuses it across the
+  serving lifetime — the jit-cache discipline that replaces vLLM's CUDA-graph
+  capture;
+- fuses sampling into the step program so only sampled token ids (B int32)
+  cross device->host per step.
+
+Parallelism: the engine runs its step under an optional device mesh with
+tensor-parallel sharding (parallel/mesh.py, parallel/sharding.py). DP
+replication happens one level up (multiple engine pods behind the router,
+as in reference values-01-minimal-example2.yaml), PP in parallel/pp.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import EngineConfig
+from ..models import llama as model_lib
+from ..models.llama import DecodeMeta, PrefillMeta
+from ..ops.sampling import sample_tokens
+from ..utils import get_logger
+from .kv_cache import KVCache, allocate_kv_cache, derive_num_pages
+from .sampling_params import SamplingParams
+from .scheduler import ScheduledBatch, Scheduler
+from .sequence import FinishReason, Sequence, SequenceStatus
+
+logger = get_logger("engine")
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: str
+    prompt_token_ids: list[int]
+    output_token_ids: list[int]
+    finished: bool
+    finish_reason: Optional[str] = None
+    new_token_ids: Optional[list[int]] = None  # tokens produced this step
+
+
+class LLMEngine:
+    def __init__(self, config: EngineConfig, params=None,
+                 eos_token_id: Optional[int] = None,
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 use_pallas: Optional[bool] = None):
+        self.config = config
+        self.model_config = config.model
+        self.eos_token_id = eos_token_id
+        self.mesh = mesh
+        self.use_pallas = use_pallas
+        self._key = jax.random.key(config.seed)
+
+        hbm_free = _device_free_memory()
+        num_pages = derive_num_pages(
+            config.model, config.cache, config.effective_max_len,
+            config.scheduler.max_num_seqs, hbm_free)
+        # Cap: no point holding more pages than max_num_seqs full sequences.
+        cap = (config.scheduler.max_num_seqs *
+               -(-config.effective_max_len // config.cache.page_size) + 1)
+        num_pages = min(num_pages, cap)
+        logger.info("KV cache: %d pages x %d tokens (page pool)",
+                    num_pages, config.cache.page_size)
+
+        self.scheduler = Scheduler(config, num_pages)
+
+        kv_sharding = params_sharding = None
+        if mesh is not None:
+            from ..parallel.sharding import kv_cache_sharding, param_shardings
+            kv_sharding = kv_cache_sharding(mesh, config.model)
+            params_sharding = param_shardings(mesh, config.model)
+
+        if params is None:
+            logger.info("initializing random weights for %s", config.model.name)
+            params = model_lib.init_params(config.model, jax.random.key(config.seed))
+        if params_sharding is not None:
+            params = jax.device_put(params, params_sharding)
+        self.params = params
+        self.kv_cache = allocate_kv_cache(config.model, config.cache, num_pages,
+                                          kv_sharding)
+
+        self._prefill_fn = self._build_prefill_fn()
+        self._decode_fn = self._build_decode_fn()
+        self.step_count = 0
+
+    # -- jitted step programs ----------------------------------------------
+
+    def _build_prefill_fn(self):
+        cfg = self.model_config
+        use_pallas = self.use_pallas
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill_step(params, kv: KVCache, tokens, meta: PrefillMeta, key,
+                         temperature, top_k, top_p):
+            hidden, kv, _ = model_lib.forward_prefill(
+                params, cfg, tokens, meta, kv, use_pallas=use_pallas)
+            logits = model_lib.compute_logits(params, cfg, hidden)
+            next_tokens = sample_tokens(logits, key, temperature, top_k, top_p)
+            return next_tokens, kv
+
+        return prefill_step
+
+    def _build_decode_fn(self):
+        cfg = self.model_config
+        use_pallas = self.use_pallas
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode_step(params, kv: KVCache, tokens, meta: DecodeMeta, key,
+                        temperature, top_k, top_p):
+            hidden, kv, _ = model_lib.forward_decode(
+                params, cfg, tokens, meta, kv, use_pallas=use_pallas)
+            logits = model_lib.compute_logits(params, cfg, hidden)
+            next_tokens = sample_tokens(logits, key, temperature, top_k, top_p)
+            return next_tokens, kv
+
+        return decode_step
+
+    # -- public API ---------------------------------------------------------
+
+    def add_request(self, request_id: str, prompt_token_ids: list[int],
+                    params: Optional[SamplingParams] = None) -> None:
+        seq = Sequence(request_id, prompt_token_ids, params or SamplingParams(),
+                       eos_token_id=self.eos_token_id)
+        self.scheduler.add(seq)
+
+    def abort_request(self, request_id: str) -> bool:
+        return self.scheduler.abort(request_id)
+
+    def has_unfinished_requests(self) -> bool:
+        return self.scheduler.has_work()
+
+    def step(self) -> list[RequestOutput]:
+        """Run one engine iteration (one prefill or decode device step) and
+        return outputs for sequences that advanced."""
+        batch = self.scheduler.schedule()
+        if batch is None:
+            return []
+        self.step_count += 1
+        self._key, step_key = jax.random.split(self._key)
+
+        if batch.kind == "prefill":
+            meta = PrefillMeta(
+                seg_ids=jnp.asarray(batch.seg_ids),
+                positions=jnp.asarray(batch.positions),
+                slot_mapping=jnp.asarray(batch.slot_mapping),
+                logits_indices=jnp.asarray(batch.logits_indices))
+            next_tokens, self.kv_cache = self._prefill_fn(
+                self.params, self.kv_cache, jnp.asarray(batch.tokens), meta,
+                step_key, jnp.asarray(batch.temperature),
+                jnp.asarray(batch.top_k), jnp.asarray(batch.top_p))
+        else:
+            meta = DecodeMeta(
+                positions=jnp.asarray(batch.positions),
+                slot_mapping=jnp.asarray(batch.slot_mapping),
+                page_tables=jnp.asarray(batch.page_tables),
+                context_lens=jnp.asarray(batch.context_lens))
+            next_tokens, self.kv_cache = self._decode_fn(
+                self.params, self.kv_cache, jnp.asarray(batch.tokens), meta,
+                step_key, jnp.asarray(batch.temperature),
+                jnp.asarray(batch.top_k), jnp.asarray(batch.top_p))
+
+        next_tokens = np.asarray(next_tokens)  # the only device->host transfer
+        return self._process_outputs(batch, next_tokens)
+
+    def _process_outputs(self, batch: ScheduledBatch,
+                         next_tokens: np.ndarray) -> list[RequestOutput]:
+        outputs = []
+        for s, seq in enumerate(batch.seqs):
+            token = int(next_tokens[s])
+            seq.append_token(token)
+            reason = seq.check_stop(self.config.effective_max_len)
+            if reason is not None:
+                self.scheduler.finish(seq, reason)
+            outputs.append(RequestOutput(
+                request_id=seq.request_id,
+                prompt_token_ids=seq.prompt_token_ids,
+                output_token_ids=list(seq.output_token_ids),
+                finished=seq.is_finished,
+                finish_reason=seq.finish_reason.value if seq.finish_reason else None,
+                new_token_ids=[token]))
+        return outputs
+
+    # -- convenience --------------------------------------------------------
+
+    def generate(self, prompts: list[list[int]],
+                 params: Optional[SamplingParams] = None,
+                 ) -> list[RequestOutput]:
+        """Synchronous batch generation (offline / test path)."""
+        for i, p in enumerate(prompts):
+            self.add_request(f"req-{i}", p, params)
+        final: dict[str, RequestOutput] = {}
+        while self.has_unfinished_requests():
+            for out in self.step():
+                if out.finished:
+                    final[out.request_id] = out
+        return [final[f"req-{i}"] for i in range(len(prompts))]
+
+
+def _device_free_memory() -> Optional[int]:
+    """Free HBM bytes on the first addressable device, when the backend
+    reports it (TPU does; CPU returns None -> test-sized pool)."""
+    try:
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"]) - int(stats.get("bytes_in_use", 0))
+    except Exception:
+        pass
+    return None

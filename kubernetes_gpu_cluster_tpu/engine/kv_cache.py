@@ -1,0 +1,145 @@
+"""Paged KV cache: device-side page pool + host-side page allocator.
+
+The reference relied on vLLM's PagedAttention block manager inside the CUDA
+images and only exposed sizing knobs (``gpuMemoryUtilization``, ``maxModelLen``
+— reference ``values-01-minimal-example8.yaml:26-27``, SURVEY C29). Here the
+paged cache is native:
+
+- Device side: one K and one V array of shape
+  ``[num_layers, num_pages, page_size, num_kv_heads, head_dim]`` living in HBM.
+  Layout rationale (TPU): the last two dims (num_kv_heads*head_dim) flatten to a
+  lane-aligned vector; a page is the DMA unit the Pallas decode kernel streams
+  HBM->VMEM. A single stacked array per K/V keeps jit donation trivial
+  (the cache is donated every step, so updates alias in place — no copies).
+- Host side: ``PageAllocator`` — a free-list allocator with optional
+  copy-on-write-free refcounts, mirroring vLLM's block manager role. Page 0 is
+  reserved as a scrap page: padding tokens write there so scatter updates need
+  no masking inside jit.
+
+A C++ implementation of the allocator hot path lives in
+cluster/native (same algorithm) and is used when built; this Python version is
+the always-available reference implementation.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig, CacheConfig
+from ..utils import cdiv, get_logger
+
+logger = get_logger("kv_cache")
+
+# Page 0 never backs real tokens; padding slots scatter into it.
+SCRAP_PAGE = 0
+
+
+class KVCache(NamedTuple):
+    """Device-side paged KV pool. k/v: [L, P, page_size, n_kv, head_dim]."""
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+
+def allocate_kv_cache(
+    model: ModelConfig,
+    cache: CacheConfig,
+    num_pages: int,
+    sharding: Optional[jax.sharding.Sharding] = None,
+) -> KVCache:
+    dtype = jnp.dtype(cache.dtype) if cache.dtype else model.jnp_dtype
+    shape = (model.num_layers, num_pages, cache.page_size, model.num_kv_heads, model.head_dim)
+    def mk():
+        return jnp.zeros(shape, dtype=dtype)
+    if sharding is not None:
+        mk_sharded = jax.jit(mk, out_shardings=sharding)
+        return KVCache(k=mk_sharded(), v=mk_sharded())
+    return KVCache(k=mk(), v=mk())
+
+
+def kv_cache_bytes_per_page(model: ModelConfig, cache: CacheConfig) -> int:
+    dtype = jnp.dtype(cache.dtype) if cache.dtype else model.jnp_dtype
+    per_tok = model.num_kv_heads * model.head_dim * dtype.itemsize
+    return 2 * model.num_layers * cache.page_size * per_tok
+
+
+def derive_num_pages(
+    model: ModelConfig,
+    cache: CacheConfig,
+    max_model_len: int,
+    max_num_seqs: int,
+    hbm_free_bytes: Optional[int] = None,
+) -> int:
+    """Size the page pool. If ``cache.num_pages`` is set, use it; else use
+    ``hbm_utilization`` of free HBM (the reference's gpuMemoryUtilization
+    semantics); else fall back to enough pages for max_num_seqs full-length
+    sequences (CPU/test path)."""
+    if cache.num_pages is not None:
+        return cache.num_pages
+    if hbm_free_bytes is not None:
+        budget = int(hbm_free_bytes * cache.hbm_utilization)
+        n = budget // kv_cache_bytes_per_page(model, cache)
+        if n < 2:
+            raise ValueError(
+                f"HBM budget {budget} too small for even 2 KV pages "
+                f"({kv_cache_bytes_per_page(model, cache)} B/page)")
+        return n
+    pages_per_seq = cdiv(max_model_len, cache.page_size)
+    return max_num_seqs * pages_per_seq + 1  # +1 scrap page
+
+
+class PageAllocator:
+    """Free-list page allocator with refcounts (enables future copy-on-write
+    prefix sharing). All operations O(1) amortized. Host-side only — the device
+    never sees this object, just the block tables it produces."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        assert num_pages >= 2, "need at least scrap page + 1 usable page"
+        self.num_pages = num_pages
+        self.page_size = page_size
+        # Page 0 is the scrap page and never allocatable.
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))
+        self._refcount: dict[int, int] = {}
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def can_allocate(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def allocate(self, n: int) -> list[int]:
+        if not self.can_allocate(n):
+            raise RuntimeError(f"KV page pool exhausted: want {n}, free {self.num_free}")
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refcount[p] = 1
+        return pages
+
+    def fork(self, page: int) -> None:
+        """Increment refcount (copy-on-write prefix sharing)."""
+        self._refcount[page] += 1
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            rc = self._refcount.get(p)
+            if rc is None:
+                raise RuntimeError(f"double free of page {p}")
+            if rc == 1:
+                del self._refcount[p]
+                self._free.append(p)
+            else:
+                self._refcount[p] = rc - 1
+
+    def pages_for_tokens(self, num_tokens: int) -> int:
+        return cdiv(num_tokens, self.page_size)

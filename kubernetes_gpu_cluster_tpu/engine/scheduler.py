@@ -1,0 +1,255 @@
+"""Continuous-batching scheduler.
+
+The serving hot loop the reference only shaped via ``gpuMemoryUtilization`` /
+``maxModelLen`` knobs (SURVEY §3.4 "HOT LOOP (external, in vLLM)") is native
+here. vLLM-v0-style policy:
+
+- Prefills are prioritized: waiting sequences are admitted (FCFS) up to a token
+  budget and batched into one ragged prefill step.
+- Otherwise all running sequences take one decode step.
+- Under KV-page pressure the youngest running sequence is preempted by
+  recompute (pages freed, sequence returns to the waiting queue) — the
+  engine-level analogue of the reference's reset-then-converge recovery
+  property (SURVEY §1 L1).
+
+Shape discipline: every batch is padded to bucketed shapes (batch size, token
+count, pages-per-seq) so the number of distinct XLA compilations is small and
+bounded — this is what keeps continuous batching recompilation-storm-free
+under jit (SURVEY §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..config import EngineConfig
+from ..utils import cdiv, get_logger
+from ..utils.math import next_power_of_2
+from .kv_cache import PageAllocator
+from .sequence import Sequence, SequenceStatus
+
+logger = get_logger("scheduler")
+
+
+@dataclasses.dataclass
+class ScheduledBatch:
+    """One device step's worth of work, already laid out as padded numpy
+    arrays matching models.PrefillMeta / models.DecodeMeta."""
+    kind: str                      # "prefill" | "decode"
+    seqs: list[Sequence]           # the B real sequences (unpadded count)
+    tokens: np.ndarray             # prefill: [T]; decode: [B_pad]
+    positions: np.ndarray
+    slot_mapping: np.ndarray
+    # prefill only
+    seg_ids: Optional[np.ndarray] = None
+    logits_indices: Optional[np.ndarray] = None   # [B_pad]
+    # decode only
+    page_tables: Optional[np.ndarray] = None      # [B_pad, pages_bucket]
+    context_lens: Optional[np.ndarray] = None     # [B_pad]
+    # sampling arrays [B_pad]
+    temperature: Optional[np.ndarray] = None
+    top_k: Optional[np.ndarray] = None
+    top_p: Optional[np.ndarray] = None
+
+    @property
+    def num_seqs(self) -> int:
+        return len(self.seqs)
+
+
+def _bucket(value: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if value <= b:
+            return b
+    return buckets[-1] if buckets and value <= buckets[-1] else next_power_of_2(value)
+
+
+class Scheduler:
+    def __init__(self, config: EngineConfig, num_pages: int):
+        self.config = config
+        sc = config.scheduler
+        self.max_num_seqs = sc.max_num_seqs
+        self.max_prefill_tokens = sc.max_prefill_tokens
+        self.decode_buckets = sc.decode_buckets
+        self.prefill_buckets = sc.prefill_buckets
+        self.page_size = config.cache.page_size
+        self.allocator = PageAllocator(num_pages, self.page_size)
+        self.waiting: deque[Sequence] = deque()
+        self.running: list[Sequence] = []
+        # Monotone high-water marks for padded shapes (stats/debug).
+        self.num_preemptions = 0
+
+    # -- queue management ---------------------------------------------------
+
+    def add(self, seq: Sequence) -> None:
+        if seq.num_prompt_tokens == 0:
+            raise ValueError("prompt must contain at least one token")
+        max_prompt = min(self.config.effective_max_len - 1, self.prefill_buckets[-1])
+        if seq.num_prompt_tokens > max_prompt:
+            raise ValueError(
+                f"prompt of {seq.num_prompt_tokens} tokens exceeds limit {max_prompt}")
+        self.waiting.append(seq)
+
+    def abort(self, request_id: str) -> bool:
+        for seq in list(self.waiting):
+            if seq.request_id == request_id:
+                self.waiting.remove(seq)
+                return True
+        for seq in self.running:
+            if seq.request_id == request_id:
+                self._release(seq)
+                self.running.remove(seq)
+                return True
+        return False
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def _release(self, seq: Sequence) -> None:
+        if seq.pages:
+            self.allocator.free(seq.pages)
+            seq.pages = []
+
+    def finish(self, seq: Sequence, reason) -> None:
+        seq.status = SequenceStatus.FINISHED
+        seq.finish_reason = reason
+        self._release(seq)
+        if seq in self.running:
+            self.running.remove(seq)
+
+    def _preempt_youngest(self) -> bool:
+        """Evict the most recently admitted running sequence (recompute-style
+        preemption). Returns False if nothing can be preempted."""
+        if not self.running:
+            return False
+        victim = self.running.pop()  # admission order => last is youngest
+        self._release(victim)
+        victim.status = SequenceStatus.PREEMPTED
+        # Recompute-style preemption: pages are gone; on readmission the
+        # prefill replays all_token_ids (prompt + generated so far) so the
+        # prompt/output split — and with it max_tokens accounting — is kept.
+        self.waiting.appendleft(victim)
+        self.num_preemptions += 1
+        logger.warning("preempted %s (KV pages exhausted; free=%d)",
+                       victim.request_id, self.allocator.num_free)
+        return True
+
+    # -- scheduling ---------------------------------------------------------
+
+    def schedule(self) -> Optional[ScheduledBatch]:
+        batch = self._schedule_prefills()
+        if batch is not None:
+            return batch
+        return self._schedule_decode()
+
+    def _schedule_prefills(self) -> Optional[ScheduledBatch]:
+        admitted: list[Sequence] = []
+        total_tokens = 0
+        while self.waiting:
+            seq = self.waiting[0]
+            if len(self.running) + len(admitted) >= self.max_num_seqs:
+                break
+            # A single oversized (recomputed) sequence may exceed the budget
+            # alone — admit it solo rather than starving it.
+            if admitted and total_tokens + seq.num_tokens > self.max_prefill_tokens:
+                break
+            need = cdiv(seq.num_tokens, self.page_size)
+            if not self.allocator.can_allocate(need):
+                # No pages for this prompt: try to free some by preempting,
+                # unless nothing is running (then we must wait for finishes).
+                if admitted or not self._preempt_youngest():
+                    break
+                continue
+            seq.pages = self.allocator.allocate(need)
+            self.waiting.popleft()
+            admitted.append(seq)
+            total_tokens += seq.num_tokens
+        if not admitted:
+            return None
+
+        T = _bucket(total_tokens, self.prefill_buckets)
+        B = _bucket(len(admitted), self.decode_buckets)
+        tokens = np.zeros(T, np.int32)
+        seg_ids = np.full(T, -1, np.int32)
+        positions = np.zeros(T, np.int32)
+        slot_mapping = np.zeros(T, np.int32)   # scrap page slots for padding
+        logits_indices = np.zeros(B, np.int32)
+        i = 0
+        for s, seq in enumerate(admitted):
+            n = seq.num_tokens
+            tokens[i:i + n] = seq.all_token_ids
+            seg_ids[i:i + n] = s
+            positions[i:i + n] = np.arange(n)
+            page_arr = np.asarray(seq.pages, np.int64)
+            tok_pos = np.arange(n)
+            slot_mapping[i:i + n] = (page_arr[tok_pos // self.page_size] *
+                                     self.page_size + tok_pos % self.page_size)
+            i += n
+            logits_indices[s] = i - 1
+            seq.status = SequenceStatus.RUNNING
+            self.running.append(seq)
+
+        return ScheduledBatch(
+            kind="prefill", seqs=admitted, tokens=tokens, positions=positions,
+            slot_mapping=slot_mapping, seg_ids=seg_ids,
+            logits_indices=logits_indices, **self._sampling_arrays(admitted, B))
+
+    def _schedule_decode(self) -> Optional[ScheduledBatch]:
+        if not self.running:
+            return None
+        # Ensure every running seq has a page for its next token; preempt the
+        # youngest until the rest fit.
+        scheduled: list[Sequence] = []
+        idx = 0
+        while idx < len(self.running):
+            seq = self.running[idx]
+            pages_needed = cdiv(seq.num_tokens, self.page_size)
+            if pages_needed > len(seq.pages):
+                assert pages_needed == len(seq.pages) + 1
+                if self.allocator.can_allocate(1):
+                    seq.pages.extend(self.allocator.allocate(1))
+                else:
+                    if not self._preempt_youngest():
+                        break
+                    continue  # retry same index (list shrank from the back)
+            scheduled.append(seq)
+            idx += 1
+        if not scheduled:
+            return None
+
+        B = _bucket(len(scheduled), self.decode_buckets)
+        max_pages = max(len(s.pages) for s in scheduled)
+        pages_bucket = next_power_of_2(max_pages)
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        slot_mapping = np.zeros(B, np.int32)
+        page_tables = np.zeros((B, pages_bucket), np.int32)
+        context_lens = np.zeros(B, np.int32)
+        for s, seq in enumerate(scheduled):
+            last = (seq.output_token_ids[-1] if seq.output_token_ids
+                    else seq.prompt_token_ids[-1])
+            pos = seq.num_tokens - 1
+            tokens[s] = last
+            positions[s] = pos
+            slot_mapping[s] = (seq.pages[pos // self.page_size] * self.page_size
+                               + pos % self.page_size)
+            page_tables[s, :len(seq.pages)] = seq.pages
+            context_lens[s] = seq.num_tokens
+
+        return ScheduledBatch(
+            kind="decode", seqs=scheduled, tokens=tokens, positions=positions,
+            slot_mapping=slot_mapping, page_tables=page_tables,
+            context_lens=context_lens, **self._sampling_arrays(scheduled, B))
+
+    def _sampling_arrays(self, seqs: list[Sequence], B: int) -> dict:
+        temperature = np.zeros(B, np.float32)   # padding rows sample greedily
+        top_k = np.zeros(B, np.int32)
+        top_p = np.ones(B, np.float32)
+        for s, seq in enumerate(seqs):
+            temperature[s] = seq.params.temperature
+            top_k[s] = seq.params.top_k
+            top_p[s] = seq.params.top_p
+        return dict(temperature=temperature, top_k=top_k, top_p=top_p)

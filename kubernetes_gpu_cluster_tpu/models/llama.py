@@ -1,0 +1,259 @@
+"""Decoder-only transformer for serving: llama-class dense + mixtral-class MoE.
+
+One config-driven implementation covers every family the framework serves
+(Llama 1/2/3, TinyLlama, Qwen2/2.5 [attention bias], Qwen3 [qk-norm],
+Mixtral [sparse MoE]) — the model set the reference deployed through vLLM
+images (reference ``values-01-minimal-example*.yaml`` modelURL fields) plus the
+BASELINE.json north-star models.
+
+TPU-first design decisions:
+- Pure functions over a params pytree; layer weights are **stacked** with a
+  leading ``[L, ...]`` axis and the layer loop is a ``lax.scan`` — one traced
+  layer body regardless of depth (compile time O(1) in L), and the paged KV
+  pool's ``[L, ...]`` leading axis threads through the scan as xs/ys.
+- Two entry points matching the serving hot loop: ``forward_prefill`` (ragged
+  flattened prompt tokens, causal-within-segment) and ``forward_decode`` (one
+  token per sequence against the paged cache). Both scatter K/V into the page
+  pool via precomputed slot mappings (padding slots land in the scrap page).
+- Matmuls stay in model dtype (bf16) with fp32 accumulation on the MXU
+  (``preferred_element_type``); norms/softmax in fp32.
+- Only the hidden states that feed sampling are projected to logits
+  (``logits_indices``), so the ``[*, vocab]`` matmul runs on B rows, not T.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from ..engine.kv_cache import KVCache
+from ..ops.rope import apply_rope, rope_cos_sin
+from ..ops.attention import (
+    write_kv_pages,
+    ragged_prefill_attention,
+    paged_decode_attention,
+)
+
+Params = dict[str, Any]
+
+
+class PrefillMeta(NamedTuple):
+    """Metadata for a ragged prefill step over T flattened prompt tokens."""
+    seg_ids: jax.Array        # [T] int32 sequence id per token; padding = -1
+    positions: jax.Array      # [T] int32 position within its sequence
+    slot_mapping: jax.Array   # [T] int32 flat KV slot (scrap page for padding)
+    logits_indices: jax.Array # [B] int32 index into T of each seq's last token
+
+
+class DecodeMeta(NamedTuple):
+    """Metadata for a decode step: one new token per sequence."""
+    positions: jax.Array      # [B] int32 position of the new token
+    slot_mapping: jax.Array   # [B] int32 flat KV slot for the new token
+    page_tables: jax.Array    # [B, pages_per_seq] int32 page ids (pad = scrap)
+    context_lens: jax.Array   # [B] int32 valid tokens incl. the new one
+
+
+# ---------------------------------------------------------------------------
+# Parameter init
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype: Optional[jnp.dtype] = None) -> Params:
+    """Random-init params (bench/tests; real weights come from engine.weights).
+    Layout: stacked [L, ...] per-layer tensors + embed/final_norm/lm_head."""
+    dtype = dtype or cfg.jnp_dtype
+    d, L = cfg.hidden_size, cfg.num_layers
+    nh, nkv, hd, ff = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.intermediate_size
+    E = cfg.num_experts
+    keys = iter(jax.random.split(key, 16))
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
+
+    layers: Params = {
+        "input_norm": jnp.ones((L, d), dtype),
+        "post_attn_norm": jnp.ones((L, d), dtype),
+        "wq": w(next(keys), (L, d, nh * hd), d),
+        "wk": w(next(keys), (L, d, nkv * hd), d),
+        "wv": w(next(keys), (L, d, nkv * hd), d),
+        "wo": w(next(keys), (L, nh * hd, d), nh * hd),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = jnp.zeros((L, nh * hd), dtype)
+        layers["bk"] = jnp.zeros((L, nkv * hd), dtype)
+        layers["bv"] = jnp.zeros((L, nkv * hd), dtype)
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, hd), dtype)
+        layers["k_norm"] = jnp.ones((L, hd), dtype)
+    if cfg.is_moe:
+        layers["router"] = w(next(keys), (L, d, E), d)
+        layers["w_gate"] = w(next(keys), (L, E, d, ff), d)
+        layers["w_up"] = w(next(keys), (L, E, d, ff), d)
+        layers["w_down"] = w(next(keys), (L, E, ff, d), ff)
+    else:
+        layers["w_gate"] = w(next(keys), (L, d, ff), d)
+        layers["w_up"] = w(next(keys), (L, d, ff), d)
+        layers["w_down"] = w(next(keys), (L, ff, d), ff)
+
+    params: Params = {
+        "embed": w(next(keys), (cfg.vocab_size, d), d),
+        "final_norm": jnp.ones((d,), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), (d, cfg.vocab_size), d)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+def _dense_mlp(lp: Params, x: jax.Array) -> jax.Array:
+    gate = jnp.dot(x, lp["w_gate"], preferred_element_type=jnp.float32)
+    up = jnp.dot(x, lp["w_up"], preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(gate) * up).astype(x.dtype)
+    return jnp.dot(h, lp["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Mixtral-style sparse MoE, dense-dispatch formulation: every expert runs
+    over all tokens; combine weights zero out non-routed pairs. Exact (no
+    capacity drops) and shard_map-friendly: under expert parallelism each
+    device evaluates its local experts and the combine is a psum over 'ep'
+    (see parallel/ep.py). T is small in the serving hot loop, so the extra
+    FLOPs stay MXU-bound rather than latency-critical."""
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    router_logits = jnp.dot(x.astype(jnp.float32), lp["router"].astype(jnp.float32))
+    topk_vals, topk_idx = jax.lax.top_k(router_logits, k)           # [T, k]
+    topk_w = jax.nn.softmax(topk_vals, axis=-1)                      # [T, k]
+    # [T, k, E] one-hot routing -> [T, E] combine weights.
+    combine = jnp.sum(jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)
+                      * topk_w[..., None], axis=1)
+
+    def expert_fn(wg, wu, wd):
+        gate = jnp.dot(x, wg, preferred_element_type=jnp.float32)
+        up = jnp.dot(x, wu, preferred_element_type=jnp.float32)
+        h = (jax.nn.silu(gate) * up).astype(x.dtype)
+        return jnp.dot(h, wd, preferred_element_type=jnp.float32)    # [T, d]
+
+    expert_outs = jax.vmap(expert_fn)(lp["w_gate"], lp["w_up"], lp["w_down"])  # [E, T, d]
+    out = jnp.einsum("te,etd->td", combine, expert_outs)
+    return out.astype(x.dtype)
+
+
+def _qkv(lp: Params, cfg: ModelConfig, x: jax.Array, positions: jax.Array):
+    """Project + per-head norm (qwen3) + RoPE. x: [T, d] -> q [T,nh,hd], k/v [T,nkv,hd]."""
+    T = x.shape[0]
+    q = jnp.dot(x, lp["wq"], preferred_element_type=jnp.float32)
+    k = jnp.dot(x, lp["wk"], preferred_element_type=jnp.float32)
+    v = jnp.dot(x, lp["wv"], preferred_element_type=jnp.float32)
+    if cfg.attention_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.astype(x.dtype).reshape(T, cfg.num_heads, cfg.head_dim)
+    k = k.astype(x.dtype).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    v = v.astype(x.dtype).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    return q, k, v
+
+
+def _mlp_block(lp: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    if cfg.is_moe:
+        return _moe_mlp(lp, x, cfg)
+    return _dense_mlp(lp, x)
+
+
+# ---------------------------------------------------------------------------
+# Forward passes (scan over stacked layers; KV pool threads through as xs/ys)
+# ---------------------------------------------------------------------------
+
+def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array, kv: KVCache,
+                positions: jax.Array, attn_fn,
+                layer_slice=None) -> tuple[jax.Array, KVCache]:
+    """Scan the layer body over stacked weights. attn_fn(q, k, v, k_pool, v_pool)
+    -> (attn_out, new_k_pool, new_v_pool) with k/v already RoPE'd.
+    ``layer_slice`` restricts to a contiguous [start, stop) layer range —
+    used by pipeline-parallel stages (parallel/pp.py)."""
+    layers = params["layers"]
+    if layer_slice is not None:
+        start, stop = layer_slice
+        layers = jax.tree.map(lambda a: a[start:stop], layers)
+        kv = KVCache(k=kv.k[start:stop], v=kv.v[start:stop])
+
+    def body(h, xs):
+        lp, k_pool, v_pool = xs
+        resid = h
+        x = rms_norm(h, lp["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(lp, cfg, x, positions)
+        attn_out, k_pool, v_pool = attn_fn(lp, q, k, v, k_pool, v_pool)
+        attn_out = attn_out.reshape(x.shape[0], cfg.num_heads * cfg.head_dim)
+        o = jnp.dot(attn_out, lp["wo"], preferred_element_type=jnp.float32).astype(h.dtype)
+        h = resid + o
+        resid = h
+        x = rms_norm(h, lp["post_attn_norm"], cfg.rms_norm_eps)
+        h = resid + _mlp_block(lp, cfg, x)
+        return h, (k_pool, v_pool)
+
+    h, (new_k, new_v) = jax.lax.scan(body, h, (layers, kv.k, kv.v))
+    return h, KVCache(k=new_k, v=new_v)
+
+
+def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                    meta: PrefillMeta, kv: KVCache,
+                    layer_slice=None, use_pallas=None,
+                    hidden_in: Optional[jax.Array] = None):
+    """Ragged prefill over T flattened tokens. Returns (selected_hidden [B, d],
+    new_kv). ``hidden_in`` replaces the embedding lookup for non-first pipeline
+    stages."""
+    scale = cfg.head_dim ** -0.5
+    h = params["embed"][tokens] if hidden_in is None else hidden_in
+
+    def attn_fn(lp, q, k, v, k_pool, v_pool):
+        k_pool, v_pool = write_kv_pages(k_pool, v_pool, k, v, meta.slot_mapping)
+        out = ragged_prefill_attention(q, k, v, meta.seg_ids, meta.positions,
+                                       scale, use_pallas=use_pallas)
+        return out, k_pool, v_pool
+
+    h, kv = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn, layer_slice)
+    selected = h[meta.logits_indices]
+    return rms_norm(selected, params["final_norm"], cfg.rms_norm_eps), kv, h
+
+
+def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                   meta: DecodeMeta, kv: KVCache,
+                   layer_slice=None, use_pallas=None,
+                   hidden_in: Optional[jax.Array] = None):
+    """Decode step: B sequences, one new token each, against the paged pool.
+    Returns (normed_hidden [B, d], new_kv)."""
+    scale = cfg.head_dim ** -0.5
+    h = params["embed"][tokens] if hidden_in is None else hidden_in
+
+    def attn_fn(lp, q, k, v, k_pool, v_pool):
+        k_pool, v_pool = write_kv_pages(k_pool, v_pool, k, v, meta.slot_mapping)
+        out = paged_decode_attention(q, k_pool, v_pool, meta.page_tables,
+                                     meta.context_lens, scale, use_pallas=use_pallas)
+        return out, k_pool, v_pool
+
+    h, kv = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn, layer_slice)
+    return rms_norm(h, params["final_norm"], cfg.rms_norm_eps), kv, h
+
+
+def compute_logits(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
+    """hidden [B, d] -> logits [B, V] in fp32."""
+    w = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return jnp.dot(hidden, w, preferred_element_type=jnp.float32)

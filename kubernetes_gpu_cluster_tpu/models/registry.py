@@ -1,0 +1,14 @@
+"""Model registry: maps model names / HF ids to configs and forward fns.
+
+All supported families share one decoder implementation (models/llama.py),
+selected and specialized purely by ModelConfig — mirroring how the reference
+selected models purely via the Helm ``modelURL`` string
+(reference ``values-01-minimal-example3.yaml:8``)."""
+
+from __future__ import annotations
+
+from ..config.model_config import MODEL_PRESETS, ModelConfig, get_model_config  # noqa: F401
+
+
+def list_models() -> list[str]:
+    return sorted(MODEL_PRESETS)

@@ -1,0 +1,159 @@
+"""Attention over the paged KV cache: XLA reference implementations + dispatch.
+
+Two attention shapes exist in the serving hot loop (the part the reference
+delegated to vLLM's CUDA PagedAttention; north star requires them as native
+TPU kernels — BASELINE.json "PagedAttention and ragged-prefill rewritten as
+Pallas/XLA custom-calls"):
+
+- **ragged prefill**: all prompt tokens of the scheduled prefill batch are
+  flattened to one ``[T, ...]`` token axis with segment ids; attention is
+  causal within each segment. No per-sequence padding waste.
+- **paged decode**: one query token per sequence; K/V live in the paged pool
+  and are addressed through per-sequence page tables.
+
+This module holds the pure-XLA reference implementations (correct everywhere,
+used on CPU meshes and as the numerical oracle in tests) and the dispatchers
+that select the Pallas TPU kernels from ``ops.pallas`` when running on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import get_logger
+
+logger = get_logger("ops.attention")
+
+
+def _on_tpu(x: jax.Array | None = None) -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+# ---------------------------------------------------------------------------
+# KV page writes
+# ---------------------------------------------------------------------------
+
+def write_kv_pages(k_cache_l: jax.Array, v_cache_l: jax.Array,
+                   k_new: jax.Array, v_new: jax.Array,
+                   slot_mapping: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Scatter new K/V vectors into the page pool for one layer.
+
+    k_cache_l/v_cache_l: [P, page_size, n_kv, hd] (this layer's pool)
+    k_new/v_new:         [T, n_kv, hd]
+    slot_mapping:        [T] int32 flat slot = page_id * page_size + offset.
+                         Padding tokens carry slots inside the scrap page 0.
+    """
+    P, ps, n_kv, hd = k_cache_l.shape
+    flat_k = k_cache_l.reshape(P * ps, n_kv, hd)
+    flat_v = v_cache_l.reshape(P * ps, n_kv, hd)
+    flat_k = flat_k.at[slot_mapping].set(k_new.astype(flat_k.dtype))
+    flat_v = flat_v.at[slot_mapping].set(v_new.astype(flat_v.dtype))
+    return flat_k.reshape(k_cache_l.shape), flat_v.reshape(v_cache_l.shape)
+
+
+# ---------------------------------------------------------------------------
+# Ragged prefill attention
+# ---------------------------------------------------------------------------
+
+def ragged_prefill_attention_xla(
+    q: jax.Array,            # [T, n_heads, hd] (post-RoPE)
+    k: jax.Array,            # [T, n_kv, hd]
+    v: jax.Array,            # [T, n_kv, hd]
+    seg_ids: jax.Array,      # [T] int32 segment id per token; padding = -1
+    positions: jax.Array,    # [T] int32 position within segment
+    scale: float,
+) -> jax.Array:
+    """Dense masked reference implementation: causal within each segment.
+    O(T^2) memory in the score matrix — fine for test shapes and moderate
+    prefill buckets; TPU uses the flash-style Pallas kernel instead."""
+    T, n_heads, hd = q.shape
+    n_kv = k.shape[1]
+    q_per_kv = n_heads // n_kv
+
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # Grouped-query layout: [T, n_kv, q_per_kv, hd]
+    qg = qf.reshape(T, n_kv, q_per_kv, hd)
+    scores = jnp.einsum("tkgh,skh->kgts", qg, kf)            # [n_kv, g, T, T]
+
+    same_seg = (seg_ids[:, None] == seg_ids[None, :]) & (seg_ids[:, None] >= 0)
+    causal = positions[:, None] >= positions[None, :]
+    mask = same_seg & causal                                  # [T, T]
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)           # fully-masked rows
+    out = jnp.einsum("kgts,skh->tkgh", probs, vf)             # [T, n_kv, g, hd]
+    return out.reshape(T, n_heads, hd).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Paged decode attention
+# ---------------------------------------------------------------------------
+
+def paged_decode_attention_xla(
+    q: jax.Array,            # [B, n_heads, hd] (post-RoPE)
+    k_cache_l: jax.Array,    # [P, page_size, n_kv, hd]
+    v_cache_l: jax.Array,    # [P, page_size, n_kv, hd]
+    page_tables: jax.Array,  # [B, pages_per_seq] int32 page ids (pad = 0/scrap)
+    context_lens: jax.Array, # [B] int32 number of valid tokens (incl. current)
+    scale: float,
+) -> jax.Array:
+    """Gather-then-attend reference implementation. The gather materializes
+    [B, pages_per_seq*page_size] worth of K/V — HBM-bandwidth-bound, which is
+    what the Pallas kernel (pallas_paged_decode) avoids by streaming pages
+    through VMEM with online softmax."""
+    B, n_heads, hd = q.shape
+    P, ps, n_kv, _ = k_cache_l.shape
+    pages_per_seq = page_tables.shape[1]
+    L = pages_per_seq * ps
+    q_per_kv = n_heads // n_kv
+
+    k_seq = k_cache_l[page_tables].reshape(B, L, n_kv, hd).astype(jnp.float32)
+    v_seq = v_cache_l[page_tables].reshape(B, L, n_kv, hd).astype(jnp.float32)
+
+    qg = (q.astype(jnp.float32) * scale).reshape(B, n_kv, q_per_kv, hd)
+    scores = jnp.einsum("bkgh,blkh->bkgl", qg, k_seq)         # [B, n_kv, g, L]
+    valid = jnp.arange(L)[None, :] < context_lens[:, None]    # [B, L]
+    scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    out = jnp.einsum("bkgl,blkh->bkgh", probs, v_seq)
+    return out.reshape(B, n_heads, hd).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Dispatchers (Pallas on TPU, XLA elsewhere)
+# ---------------------------------------------------------------------------
+
+def ragged_prefill_attention(q, k, v, seg_ids, positions, scale, *, use_pallas=None):
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if use_pallas:
+        try:
+            from .pallas.flash_prefill import flash_ragged_prefill
+            return flash_ragged_prefill(q, k, v, seg_ids, positions, scale)
+        except Exception as e:  # pragma: no cover - fallback safety
+            logger.warning("pallas prefill unavailable (%s); falling back to XLA", e)
+    return ragged_prefill_attention_xla(q, k, v, seg_ids, positions, scale)
+
+
+def paged_decode_attention(q, k_cache_l, v_cache_l, page_tables, context_lens,
+                           scale, *, use_pallas=None):
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if use_pallas:
+        try:
+            from .pallas.paged_decode import pallas_paged_decode
+            return pallas_paged_decode(q, k_cache_l, v_cache_l, page_tables,
+                                       context_lens, scale)
+        except Exception as e:  # pragma: no cover - fallback safety
+            logger.warning("pallas decode unavailable (%s); falling back to XLA", e)
+    return paged_decode_attention_xla(q, k_cache_l, v_cache_l, page_tables,
+                                      context_lens, scale)

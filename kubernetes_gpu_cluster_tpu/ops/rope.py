@@ -1,0 +1,32 @@
+"""Rotary position embeddings (half-split convention, matching HF llama/qwen).
+
+Computed on the fly from integer positions — no precomputed cos/sin table to
+keep resident or re-slice, which keeps decode steps free of dynamic-slice ops
+on a side table and lets XLA fuse the rotation into the q/k projections.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(positions: jnp.ndarray, head_dim: int, theta: float, dtype=jnp.float32):
+    """positions: [...] int32 -> cos/sin of shape [..., head_dim//2]."""
+    half = head_dim // 2
+    freq_exponents = jnp.arange(half, dtype=jnp.float32) / half
+    inv_freq = theta ** -freq_exponents                       # [half]
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: [..., n_heads, head_dim]; cos/sin: [..., head_dim//2] (broadcast over
+    the heads axis). Half-split rotation: (x1, x2) -> (x1*c - x2*s, x2*c + x1*s).
+    """
+    half = x.shape[-1] // 2
+    x1 = x[..., :half]
+    x2 = x[..., half:]
+    c = cos[..., None, :]
+    s = sin[..., None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,55 @@
+"""On-device token sampling: greedy / temperature / top-k / top-p.
+
+Runs inside the same jit as the forward step so no logits ever cross
+host<->device (the reference's vLLM engine does the same on GPU). All
+sampling params are per-sequence arrays so one compiled program serves
+heterogeneous requests without recompilation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _apply_top_k(logits: jax.Array, top_k: jax.Array) -> jax.Array:
+    """Mask all but the top-k logits per row. top_k: [B] int32; 0 => disabled."""
+    V = logits.shape[-1]
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]        # descending
+    k = jnp.where(top_k <= 0, V, top_k)
+    k = jnp.clip(k, 1, V)
+    thresh = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def _apply_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Nucleus sampling mask. top_p: [B] float32; 1.0 => disabled."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
+    cumsum = jnp.cumsum(sorted_probs, axis=-1)
+    # Number of tokens needed to reach mass top_p (always keep >= 1).
+    keep = jnp.sum(cumsum - sorted_probs < top_p[:, None], axis=-1)
+    keep = jnp.clip(keep, 1, logits.shape[-1])
+    thresh = jnp.take_along_axis(sorted_probs, (keep - 1)[:, None], axis=-1)
+    return jnp.where(probs < thresh, -jnp.inf, logits)
+
+
+def sample_tokens(
+    logits: jax.Array,        # [B, V] float32
+    key: jax.Array,           # PRNG key
+    temperature: jax.Array,   # [B] float32; 0 => greedy
+    top_k: jax.Array,         # [B] int32; 0 => disabled
+    top_p: jax.Array,         # [B] float32; 1.0 => disabled
+) -> jax.Array:
+    """Returns sampled token ids [B] int32. Greedy rows (temperature==0)
+    ignore the random draw entirely."""
+    logits = logits.astype(jnp.float32)
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    safe_temp = jnp.where(temperature <= 0, 1.0, temperature)
+    scaled = logits / safe_temp[:, None]
+    scaled = _apply_top_k(scaled, top_k)
+    scaled = _apply_top_p(scaled, top_p)
+    sampled_ids = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    return jnp.where(temperature <= 0, greedy_ids, sampled_ids)

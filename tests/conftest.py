@@ -1,0 +1,26 @@
+"""Test configuration: run every test on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding correctness (tp/pp/
+dp/ep) is validated on XLA's host-platform virtual devices instead — the
+fake-backend test strategy the reference lacked entirely (SURVEY §4: "no
+automated tests in the reference").
+
+Note: this sandbox force-registers a TPU backend from sitecustomize, so the
+env-var route (JAX_PLATFORMS=cpu) is not enough — we must also flip the jax
+config knob before any computation runs.
+"""
+
+import os
+
+# Must be set before jax initializes its backends.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+assert jax.device_count() == 8, f"expected 8 virtual CPU devices, got {jax.devices()}"
