@@ -32,7 +32,7 @@ from ..config import EngineConfig
 from ..models import llama as model_lib
 from ..models.llama import DecodeMeta, PrefillMeta
 from ..ops.sampling import sample_tokens
-from ..utils import get_logger
+from ..utils import cdiv, get_logger
 from .kv_cache import KVCache, allocate_kv_cache, derive_num_pages
 from .sampling_params import SamplingParams
 from .scheduler import ScheduledBatch, Scheduler
@@ -69,7 +69,7 @@ class LLMEngine:
             config.scheduler.max_num_seqs, hbm_free)
         # Cap: no point holding more pages than max_num_seqs full sequences.
         cap = (config.scheduler.max_num_seqs *
-               -(-config.effective_max_len // config.cache.page_size) + 1)
+               cdiv(config.effective_max_len, config.cache.page_size) + 1)
         num_pages = min(num_pages, cap)
         logger.info("KV cache: %d pages x %d tokens (page pool)",
                     num_pages, config.cache.page_size)

@@ -30,7 +30,7 @@ from ..config import EngineConfig
 from ..utils import cdiv, get_logger
 from ..utils.math import next_power_of_2
 from .kv_cache import PageAllocator
-from .sequence import Sequence, SequenceStatus
+from .sequence import FinishReason, Sequence, SequenceStatus
 
 logger = get_logger("scheduler")
 
@@ -64,7 +64,7 @@ def _bucket(value: int, buckets: tuple[int, ...]) -> int:
     for b in buckets:
         if value <= b:
             return b
-    return buckets[-1] if buckets and value <= buckets[-1] else next_power_of_2(value)
+    return next_power_of_2(value)
 
 
 class Scheduler:
@@ -91,17 +91,28 @@ class Scheduler:
         if seq.num_prompt_tokens > max_prompt:
             raise ValueError(
                 f"prompt of {seq.num_prompt_tokens} tokens exceeds limit {max_prompt}")
+        # A prompt that cannot fit the page pool even when it is empty would
+        # never become schedulable — reject it up front instead of spinning.
+        usable_pages = self.allocator.num_pages - 1  # page 0 is scrap
+        need = cdiv(seq.num_prompt_tokens, self.page_size)
+        if need > usable_pages:
+            raise ValueError(
+                f"prompt needs {need} KV pages but the pool has {usable_pages}")
         self.waiting.append(seq)
 
     def abort(self, request_id: str) -> bool:
         for seq in list(self.waiting):
             if seq.request_id == request_id:
                 self.waiting.remove(seq)
+                seq.status = SequenceStatus.FINISHED
+                seq.finish_reason = FinishReason.ABORT
                 return True
         for seq in self.running:
             if seq.request_id == request_id:
-                self._release(seq)
                 self.running.remove(seq)
+                seq.status = SequenceStatus.FINISHED
+                seq.finish_reason = FinishReason.ABORT
+                self._release(seq)
                 return True
         return False
 
@@ -158,11 +169,24 @@ class Scheduler:
                 break
             need = cdiv(seq.num_tokens, self.page_size)
             if not self.allocator.can_allocate(need):
-                # No pages for this prompt: try to free some by preempting,
-                # unless nothing is running (then we must wait for finishes).
-                if admitted or not self._preempt_youngest():
-                    break
-                continue
+                # No pages for this prompt. Never preempt running sequences to
+                # admit waiting ones — the victim would re-enter the waiting
+                # queue ahead of this sequence and immediately re-take the
+                # freed pages, churning full-recompute prefills while starving
+                # decode. Decode continues; finishes will free pages.
+                if not self.running and not admitted:
+                    # Pool is empty and the sequence still doesn't fit: it has
+                    # grown (via preempt-recompute) past total capacity and
+                    # can never be scheduled — terminate it at capacity.
+                    self.waiting.popleft()
+                    seq.status = SequenceStatus.FINISHED
+                    seq.finish_reason = FinishReason.LENGTH
+                    logger.warning(
+                        "%s needs %d pages > pool capacity %d; finishing at "
+                        "length %d", seq.request_id, need,
+                        self.allocator.num_pages - 1, seq.num_tokens)
+                    continue
+                break
             seq.pages = self.allocator.allocate(need)
             self.waiting.popleft()
             admitted.append(seq)

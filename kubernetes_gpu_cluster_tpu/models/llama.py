@@ -117,27 +117,42 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
 
 
-def _dense_mlp(lp: Params, x: jax.Array) -> jax.Array:
+def _dense_mlp(lp: Params, x: jax.Array, tp_axis: Optional[str] = None) -> jax.Array:
+    """Megatron MLP: gate/up column-sharded, down row-sharded. Under GSPMD
+    (tp_axis=None) the psum is inserted by the partitioner; inside shard_map
+    (parallel/pp.py) ``tp_axis`` names the manual mesh axis to reduce over."""
     gate = jnp.dot(x, lp["w_gate"], preferred_element_type=jnp.float32)
     up = jnp.dot(x, lp["w_up"], preferred_element_type=jnp.float32)
     h = (jax.nn.silu(gate) * up).astype(x.dtype)
-    return jnp.dot(h, lp["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
+    out = jnp.dot(h, lp["w_down"], preferred_element_type=jnp.float32)
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    return out.astype(x.dtype)
 
 
-def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig,
+             tp_axis: Optional[str] = None,
+             ep_axis: Optional[str] = None) -> jax.Array:
     """Mixtral-style sparse MoE, dense-dispatch formulation: every expert runs
     over all tokens; combine weights zero out non-routed pairs. Exact (no
-    capacity drops) and shard_map-friendly: under expert parallelism each
-    device evaluates its local experts and the combine is a psum over 'ep'
-    (see parallel/ep.py). T is small in the serving hot loop, so the extra
-    FLOPs stay MXU-bound rather than latency-critical."""
+    capacity drops) and shard-friendly: under expert parallelism each device
+    evaluates its local experts and the combine reduces over the expert axis —
+    a psum over ``ep`` (automatic under GSPMD since the combine einsum
+    contracts E; explicit when ``ep_axis`` names a manual shard_map axis).
+    T is small in the serving hot loop, so the extra FLOPs stay MXU-bound
+    rather than latency-critical."""
     E, k = cfg.num_experts, cfg.num_experts_per_tok
+    # Router always sees the full expert set (router weights replicated).
     router_logits = jnp.dot(x.astype(jnp.float32), lp["router"].astype(jnp.float32))
     topk_vals, topk_idx = jax.lax.top_k(router_logits, k)           # [T, k]
     topk_w = jax.nn.softmax(topk_vals, axis=-1)                      # [T, k]
     # [T, k, E] one-hot routing -> [T, E] combine weights.
     combine = jnp.sum(jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)
                       * topk_w[..., None], axis=1)
+    E_local = lp["w_gate"].shape[0]  # E under GSPMD; E/ep inside shard_map
+    if ep_axis is not None and E_local != E:
+        start = jax.lax.axis_index(ep_axis) * E_local
+        combine = jax.lax.dynamic_slice_in_dim(combine, start, E_local, axis=1)
 
     def expert_fn(wg, wu, wd):
         gate = jnp.dot(x, wg, preferred_element_type=jnp.float32)
@@ -145,13 +160,18 @@ def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
         h = (jax.nn.silu(gate) * up).astype(x.dtype)
         return jnp.dot(h, wd, preferred_element_type=jnp.float32)    # [T, d]
 
-    expert_outs = jax.vmap(expert_fn)(lp["w_gate"], lp["w_up"], lp["w_down"])  # [E, T, d]
+    expert_outs = jax.vmap(expert_fn)(lp["w_gate"], lp["w_up"], lp["w_down"])  # [E_local, T, d]
     out = jnp.einsum("te,etd->td", combine, expert_outs)
+    reduce_axes = tuple(a for a in (ep_axis, tp_axis) if a is not None)
+    if reduce_axes:
+        out = jax.lax.psum(out, reduce_axes)
     return out.astype(x.dtype)
 
 
 def _qkv(lp: Params, cfg: ModelConfig, x: jax.Array, positions: jax.Array):
-    """Project + per-head norm (qwen3) + RoPE. x: [T, d] -> q [T,nh,hd], k/v [T,nkv,hd]."""
+    """Project + per-head norm (qwen3) + RoPE. x: [T, d] -> q [T,nh,hd], k/v [T,nkv,hd].
+    Head counts are derived from the projection widths (not cfg) so the same
+    code runs on tp-local shards inside shard_map (parallel/pp.py)."""
     T = x.shape[0]
     q = jnp.dot(x, lp["wq"], preferred_element_type=jnp.float32)
     k = jnp.dot(x, lp["wk"], preferred_element_type=jnp.float32)
@@ -160,9 +180,9 @@ def _qkv(lp: Params, cfg: ModelConfig, x: jax.Array, positions: jax.Array):
         q = q + lp["bq"]
         k = k + lp["bk"]
         v = v + lp["bv"]
-    q = q.astype(x.dtype).reshape(T, cfg.num_heads, cfg.head_dim)
-    k = k.astype(x.dtype).reshape(T, cfg.num_kv_heads, cfg.head_dim)
-    v = v.astype(x.dtype).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    q = q.astype(x.dtype).reshape(T, q.shape[-1] // cfg.head_dim, cfg.head_dim)
+    k = k.astype(x.dtype).reshape(T, k.shape[-1] // cfg.head_dim, cfg.head_dim)
+    v = v.astype(x.dtype).reshape(T, v.shape[-1] // cfg.head_dim, cfg.head_dim)
     if cfg.qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
@@ -172,10 +192,12 @@ def _qkv(lp: Params, cfg: ModelConfig, x: jax.Array, positions: jax.Array):
     return q, k, v
 
 
-def _mlp_block(lp: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
+def _mlp_block(lp: Params, cfg: ModelConfig, x: jax.Array,
+               tp_axis: Optional[str] = None,
+               ep_axis: Optional[str] = None) -> jax.Array:
     if cfg.is_moe:
-        return _moe_mlp(lp, x, cfg)
-    return _dense_mlp(lp, x)
+        return _moe_mlp(lp, x, cfg, tp_axis=tp_axis, ep_axis=ep_axis)
+    return _dense_mlp(lp, x, tp_axis=tp_axis)
 
 
 # ---------------------------------------------------------------------------
@@ -184,11 +206,15 @@ def _mlp_block(lp: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
 
 def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array, kv: KVCache,
                 positions: jax.Array, attn_fn,
-                layer_slice=None) -> tuple[jax.Array, KVCache]:
+                layer_slice=None,
+                tp_axis: Optional[str] = None,
+                ep_axis: Optional[str] = None) -> tuple[jax.Array, KVCache]:
     """Scan the layer body over stacked weights. attn_fn(q, k, v, k_pool, v_pool)
     -> (attn_out, new_k_pool, new_v_pool) with k/v already RoPE'd.
-    ``layer_slice`` restricts to a contiguous [start, stop) layer range —
-    used by pipeline-parallel stages (parallel/pp.py)."""
+    ``layer_slice`` restricts to a contiguous [start, stop) layer range.
+    ``tp_axis``/``ep_axis`` name manual mesh axes when running inside
+    shard_map (parallel/pp.py); under GSPMD they stay None and the SPMD
+    partitioner inserts the equivalent collectives."""
     layers = params["layers"]
     if layer_slice is not None:
         start, stop = layer_slice
@@ -201,12 +227,14 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array, kv: KVCache,
         x = rms_norm(h, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, x, positions)
         attn_out, k_pool, v_pool = attn_fn(lp, q, k, v, k_pool, v_pool)
-        attn_out = attn_out.reshape(x.shape[0], cfg.num_heads * cfg.head_dim)
-        o = jnp.dot(attn_out, lp["wo"], preferred_element_type=jnp.float32).astype(h.dtype)
-        h = resid + o
+        attn_out = attn_out.reshape(x.shape[0], -1)
+        o = jnp.dot(attn_out, lp["wo"], preferred_element_type=jnp.float32)
+        if tp_axis is not None:  # row-sharded wo: partial sums over local heads
+            o = jax.lax.psum(o, tp_axis)
+        h = resid + o.astype(h.dtype)
         resid = h
         x = rms_norm(h, lp["post_attn_norm"], cfg.rms_norm_eps)
-        h = resid + _mlp_block(lp, cfg, x)
+        h = resid + _mlp_block(lp, cfg, x, tp_axis=tp_axis, ep_axis=ep_axis)
         return h, (k_pool, v_pool)
 
     h, (new_k, new_v) = jax.lax.scan(body, h, (layers, kv.k, kv.v))
@@ -216,10 +244,12 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array, kv: KVCache,
 def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
                     meta: PrefillMeta, kv: KVCache,
                     layer_slice=None, use_pallas=None,
-                    hidden_in: Optional[jax.Array] = None):
+                    hidden_in: Optional[jax.Array] = None,
+                    tp_axis: Optional[str] = None,
+                    ep_axis: Optional[str] = None):
     """Ragged prefill over T flattened tokens. Returns (selected_hidden [B, d],
-    new_kv). ``hidden_in`` replaces the embedding lookup for non-first pipeline
-    stages."""
+    new_kv, raw_hidden [T, d]). ``hidden_in`` replaces the embedding lookup for
+    non-first pipeline stages; ``raw_hidden`` is what rotates stage-to-stage."""
     scale = cfg.head_dim ** -0.5
     h = params["embed"][tokens] if hidden_in is None else hidden_in
 
@@ -229,7 +259,8 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
                                        scale, use_pallas=use_pallas)
         return out, k_pool, v_pool
 
-    h, kv = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn, layer_slice)
+    h, kv = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn, layer_slice,
+                        tp_axis=tp_axis, ep_axis=ep_axis)
     selected = h[meta.logits_indices]
     return rms_norm(selected, params["final_norm"], cfg.rms_norm_eps), kv, h
 
@@ -237,9 +268,11 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
 def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
                    meta: DecodeMeta, kv: KVCache,
                    layer_slice=None, use_pallas=None,
-                   hidden_in: Optional[jax.Array] = None):
+                   hidden_in: Optional[jax.Array] = None,
+                   tp_axis: Optional[str] = None,
+                   ep_axis: Optional[str] = None):
     """Decode step: B sequences, one new token each, against the paged pool.
-    Returns (normed_hidden [B, d], new_kv)."""
+    Returns (normed_hidden [B, d], new_kv, raw_hidden [B, d])."""
     scale = cfg.head_dim ** -0.5
     h = params["embed"][tokens] if hidden_in is None else hidden_in
 
@@ -249,7 +282,8 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
                                      meta.context_lens, scale, use_pallas=use_pallas)
         return out, k_pool, v_pool
 
-    h, kv = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn, layer_slice)
+    h, kv = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn, layer_slice,
+                        tp_axis=tp_axis, ep_axis=ep_axis)
     return rms_norm(h, params["final_norm"], cfg.rms_norm_eps), kv, h
 
 

@@ -1,0 +1,192 @@
+"""Sharding correctness on the 8-device virtual CPU mesh.
+
+Strategy (SURVEY §4: the fake-backend testing the reference lacked): every
+parallel path must produce the same numbers as the single-device oracle —
+TP/EP via GSPMD annotations, EP via manual shard_map, PP via the circular
+pipeline."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_gpu_cluster_tpu.config import EngineConfig, get_model_config
+from kubernetes_gpu_cluster_tpu.engine.engine import LLMEngine
+from kubernetes_gpu_cluster_tpu.engine.sampling_params import SamplingParams
+from kubernetes_gpu_cluster_tpu.models import llama as model_lib
+from kubernetes_gpu_cluster_tpu.parallel import make_mesh, param_shardings
+from kubernetes_gpu_cluster_tpu.parallel.ep import moe_block_ep
+from kubernetes_gpu_cluster_tpu.parallel.pp import build_pp_forward, pp_logits
+from kubernetes_gpu_cluster_tpu.engine.kv_cache import allocate_kv_cache
+from kubernetes_gpu_cluster_tpu.config.engine_config import CacheConfig
+
+
+def _greedy_engine(name, mesh=None, **overrides):
+    cfg = EngineConfig.from_model_name(name, **overrides)
+    return LLMEngine(cfg, mesh=mesh, eos_token_id=None)
+
+
+PROMPTS = [[1, 5, 9, 2], [3, 3, 7], [11, 4, 8, 6, 2, 10]]
+GREEDY = SamplingParams(temperature=0.0, max_tokens=8)
+
+
+def _generate_tokens(engine):
+    outs = engine.generate(PROMPTS, GREEDY)
+    return [o.output_token_ids for o in outs]
+
+
+class TestTensorParallel:
+    def test_tp_matches_single_device(self):
+        """Same params served on a 1-device engine and a tp=4 mesh engine must
+        greedy-decode identical tokens."""
+        cfg = EngineConfig.from_model_name("debug-tiny")
+        params = model_lib.init_params(cfg.model, jax.random.key(0))
+        ref = LLMEngine(cfg, params=params)
+        ref_tokens = _generate_tokens(ref)
+
+        mesh = make_mesh(tp=4, dp=2)
+        tp = LLMEngine(cfg, params=params, mesh=mesh)
+        tp_tokens = _generate_tokens(tp)
+        assert ref_tokens == tp_tokens
+
+    def test_tp_param_shardings_cover_params(self):
+        cfg = get_model_config("debug-moe")
+        mesh = make_mesh(tp=2, ep=2, dp=2)
+        params = model_lib.init_params(cfg, jax.random.key(0))
+        shardings = param_shardings(mesh, cfg)
+        # Structures must match exactly (device_put would fail otherwise).
+        jax.tree.map(lambda a, s: None, params, shardings)
+
+    def test_tp_rejects_indivisible_heads(self):
+        cfg = get_model_config("debug-tiny")  # 4 heads
+        mesh = make_mesh(tp=8)
+        with pytest.raises(ValueError, match="not divisible"):
+            param_shardings(mesh, cfg)
+
+
+class TestExpertParallel:
+    def test_moe_ep_matches_single_device(self):
+        """MoE engine on an ep=2 x tp=2 mesh must match the 1-device engine."""
+        cfg = EngineConfig.from_model_name("debug-moe")
+        params = model_lib.init_params(cfg.model, jax.random.key(1))
+        ref = LLMEngine(cfg, params=params)
+        ref_tokens = _generate_tokens(ref)
+
+        mesh = make_mesh(tp=2, ep=2, dp=2)
+        ep = LLMEngine(cfg, params=params, mesh=mesh)
+        ep_tokens = _generate_tokens(ep)
+        assert ref_tokens == ep_tokens
+
+    def test_moe_block_shard_map_matches_dense(self):
+        cfg = get_model_config("debug-moe")
+        key = jax.random.key(2)
+        params = model_lib.init_params(cfg, key)
+        lp = jax.tree.map(lambda a: a[0], params["layers"])  # layer 0
+        layer = {k: lp[k] for k in ("router", "w_gate", "w_up", "w_down")}
+        x = jax.random.normal(jax.random.key(3), (6, cfg.hidden_size), jnp.float32)
+
+        dense = model_lib._moe_mlp(layer, x, cfg)
+        mesh = make_mesh(tp=2, ep=2, dp=2)
+        ep_out = moe_block_ep(mesh, cfg, layer, x)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(ep_out),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestPipelineParallel:
+    def _setup(self, name="debug-tiny", pp=2, tp=1):
+        cfg = get_model_config(name)
+        mesh = make_mesh(pp=pp, tp=tp, dp=8 // (pp * tp))
+        params = model_lib.init_params(cfg, jax.random.key(4))
+        cache_cfg = CacheConfig(page_size=8, num_pages=17)
+        kv = allocate_kv_cache(cfg, cache_cfg, 17)
+        return cfg, mesh, params, kv, cache_cfg
+
+    def _prefill_meta(self, M, T, page0):
+        """M single-sequence microbatches of T tokens each; each microbatch's
+        pages start at page0[m]."""
+        seg_ids = np.zeros((M, T), np.int32)
+        positions = np.tile(np.arange(T, dtype=np.int32), (M, 1))
+        slot = np.stack([page0[m] * 8 + np.arange(T, dtype=np.int32)
+                         for m in range(M)])
+        logits_idx = np.full((M, 1), T - 1, np.int32)
+        return model_lib.PrefillMeta(
+            seg_ids=jnp.asarray(seg_ids), positions=jnp.asarray(positions),
+            slot_mapping=jnp.asarray(slot), logits_indices=jnp.asarray(logits_idx))
+
+    def test_pp_prefill_matches_single_device(self):
+        cfg, mesh, params, kv, cache_cfg = self._setup(pp=2, tp=2)
+        M, T = 3, 8
+        tokens = np.array([[1, 5, 9, 2, 7, 3, 4, 6],
+                           [3, 3, 7, 1, 2, 8, 5, 9],
+                           [11, 4, 8, 6, 2, 10, 1, 5]], np.int32)
+        page0 = np.array([1, 2, 3])  # page 0 is scrap
+        meta_mb = self._prefill_meta(M, T, page0)
+
+        # Oracle: run each microbatch through the unsharded model.
+        kv_ref = allocate_kv_cache(cfg, cache_cfg, 17)
+        ref_logits = []
+        for m in range(M):
+            meta = jax.tree.map(lambda a: a[m], meta_mb)
+            normed, kv_ref, _ = model_lib.forward_prefill(
+                params, cfg, jnp.asarray(tokens[m]), meta, kv_ref)
+            ref_logits.append(model_lib.compute_logits(params, cfg, normed))
+
+        pp_fn = build_pp_forward(mesh, cfg, "prefill")
+        hidden_mb, kv_pp = pp_fn(params, kv, jnp.asarray(tokens), meta_mb)
+        for m in range(M):
+            got = pp_logits(params, cfg, hidden_mb[m],
+                            logits_indices=meta_mb.logits_indices[m])
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref_logits[m]),
+                                       rtol=2e-4, atol=2e-4)
+        # KV pools must match too (PP writes the same pages, layer-sharded).
+        # Page 0 is the scrap page: the pipeline's masked inactive ticks dump
+        # garbage there by design, so it is excluded.
+        np.testing.assert_allclose(np.asarray(kv_pp.k)[:, 1:],
+                                   np.asarray(kv_ref.k)[:, 1:],
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_pp_decode_matches_single_device(self):
+        cfg, mesh, params, kv, cache_cfg = self._setup(pp=2, tp=1)
+        M, B = 2, 2
+        # Pretend each sequence has 3 tokens of context already; decode token 4.
+        rng = np.random.default_rng(0)
+        kv_np_k = rng.standard_normal(np.shape(kv.k)).astype(np.float32) * 0.02
+        kv_np_v = rng.standard_normal(np.shape(kv.v)).astype(np.float32) * 0.02
+        from kubernetes_gpu_cluster_tpu.engine.kv_cache import KVCache
+        kv = KVCache(k=jnp.asarray(kv_np_k), v=jnp.asarray(kv_np_v))
+        kv_ref = KVCache(k=jnp.asarray(kv_np_k), v=jnp.asarray(kv_np_v))
+
+        tokens = np.array([[7, 9], [2, 4]], np.int32)           # [M, B]
+        positions = np.full((M, B), 3, np.int32)
+        # seq (m, b) owns page 1 + 2*m + b
+        pages = 1 + 2 * np.arange(M)[:, None] + np.arange(B)[None, :]
+        slot = (pages * 8 + 3).astype(np.int32)
+        page_tables = pages[..., None].astype(np.int32)          # [M, B, 1]
+        context_lens = np.full((M, B), 4, np.int32)
+        meta_mb = model_lib.DecodeMeta(
+            positions=jnp.asarray(positions), slot_mapping=jnp.asarray(slot),
+            page_tables=jnp.asarray(page_tables),
+            context_lens=jnp.asarray(context_lens))
+
+        ref_logits = []
+        for m in range(M):
+            meta = jax.tree.map(lambda a: a[m], meta_mb)
+            normed, kv_ref, _ = model_lib.forward_decode(
+                params, cfg, jnp.asarray(tokens[m]), meta, kv_ref)
+            ref_logits.append(model_lib.compute_logits(params, cfg, normed))
+
+        pp_fn = build_pp_forward(mesh, cfg, "decode")
+        hidden_mb, kv_pp = pp_fn(params, kv, jnp.asarray(tokens), meta_mb)
+        for m in range(M):
+            got = pp_logits(params, cfg, hidden_mb[m])
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref_logits[m]),
+                                       rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(kv_pp.k)[:, 1:],
+                                   np.asarray(kv_ref.k)[:, 1:],
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_pp_rejects_indivisible_layers(self):
+        cfg = get_model_config("debug-tiny").replace(num_layers=3)
+        mesh = make_mesh(pp=2, dp=4)
+        with pytest.raises(ValueError, match="not divisible"):
+            build_pp_forward(mesh, cfg, "decode")

@@ -1,0 +1,125 @@
+"""Scheduler admission/preemption policy regression tests."""
+
+import pytest
+
+from kubernetes_gpu_cluster_tpu.config import (
+    CacheConfig, EngineConfig, SchedulerConfig, get_model_config)
+from kubernetes_gpu_cluster_tpu.engine.scheduler import Scheduler
+from kubernetes_gpu_cluster_tpu.engine.sampling_params import SamplingParams
+from kubernetes_gpu_cluster_tpu.engine.sequence import (
+    FinishReason, Sequence, SequenceStatus)
+
+
+def _cfg(num_pages=8, page_size=4, max_num_seqs=4):
+    return EngineConfig(
+        model=get_model_config("debug-tiny"),
+        cache=CacheConfig(page_size=page_size, num_pages=num_pages),
+        scheduler=SchedulerConfig(max_num_seqs=max_num_seqs,
+                                  max_prefill_tokens=64,
+                                  decode_buckets=(1, 2, 4),
+                                  prefill_buckets=(16, 32, 64)))
+
+
+def _seq(rid, n_prompt, max_tokens=64):
+    return Sequence(rid, list(range(1, n_prompt + 1)),
+                    SamplingParams(max_tokens=max_tokens))
+
+
+class TestAdmission:
+    def test_oversized_prompt_rejected_up_front(self):
+        """A prompt needing more pages than the whole pool must raise, not
+        busy-spin forever (review finding: schedule() returned None while
+        has_work() stayed True)."""
+        cfg = _cfg(num_pages=4, page_size=4)   # 3 usable pages = 12 tokens
+        sched = Scheduler(cfg, 4)
+        with pytest.raises(ValueError, match="KV pages"):
+            sched.add(_seq("big", 13))
+        # A fitting prompt is accepted and schedulable.
+        sched.add(_seq("ok", 12))
+        assert sched.schedule() is not None
+
+    def test_no_preemption_for_waiting_sequences(self):
+        """Admitting a waiting sequence must never evict running ones (review
+        finding: preempt-at-admission churned full recomputes)."""
+        cfg = _cfg(num_pages=9, page_size=4, max_num_seqs=8)  # 8 usable pages
+        sched = Scheduler(cfg, 9)
+        for i in range(4):
+            sched.add(_seq(f"run-{i}", 8))     # 2 pages each -> pool full
+        batch = sched.schedule()
+        assert batch.kind == "prefill" and len(batch.seqs) == 4
+        sched.add(_seq("late", 8))
+        # Pool is full: the late arrival must wait; the step must be a decode
+        # of the 4 running sequences, with nobody preempted.
+        batch = sched.schedule()
+        assert batch.kind == "decode" and len(batch.seqs) == 4
+        assert sched.num_preemptions == 0
+        assert [s.request_id for s in sched.running] == [f"run-{i}" for i in range(4)]
+
+    def test_grown_sequence_at_pool_capacity_finishes(self):
+        """A recomputed sequence grown past total pool capacity terminates at
+        LENGTH instead of hanging the engine."""
+        cfg = _cfg(num_pages=3, page_size=4)   # 2 usable pages = 8 tokens
+        sched = Scheduler(cfg, 3)
+        seq = _seq("grown", 6)
+        sched.add(seq)
+        assert sched.schedule() is not None    # prefill at 6 tokens (2 pages)
+        # Simulate preempt-recompute growth past capacity: 9 tokens > 8.
+        for t in (7, 8, 9):
+            seq.append_token(t)
+        sched.running.remove(seq)
+        sched.allocator.free(seq.pages)
+        seq.pages = []
+        seq.status = SequenceStatus.PREEMPTED
+        sched.waiting.appendleft(seq)
+        assert sched.schedule() is None
+        assert seq.status == SequenceStatus.FINISHED
+        assert seq.finish_reason == FinishReason.LENGTH
+        assert not sched.has_work()
+
+
+class TestAbort:
+    def test_abort_waiting_sets_finish_reason(self):
+        sched = Scheduler(_cfg(), 8)
+        seq = _seq("a", 4)
+        sched.add(seq)
+        assert sched.abort("a")
+        assert seq.status == SequenceStatus.FINISHED
+        assert seq.finish_reason == FinishReason.ABORT
+        assert not sched.has_work()
+
+    def test_abort_running_frees_pages_and_finishes(self):
+        sched = Scheduler(_cfg(num_pages=8, page_size=4), 8)
+        seq = _seq("r", 8)
+        sched.add(seq)
+        sched.schedule()
+        free_before = sched.allocator.num_free
+        assert sched.abort("r")
+        assert seq.finish_reason == FinishReason.ABORT
+        assert sched.allocator.num_free == free_before + 2
+        assert not sched.has_work()
+
+    def test_abort_unknown_returns_false(self):
+        sched = Scheduler(_cfg(), 8)
+        assert not sched.abort("nope")
+
+
+class TestPreemptionInDecode:
+    def test_decode_preempts_youngest_when_pool_exhausted(self):
+        """Decode-path preemption (the legitimate one) still works: when a
+        running sequence needs a new page and none is free, the youngest is
+        evicted and re-queued."""
+        cfg = _cfg(num_pages=3, page_size=2, max_num_seqs=4)  # 2 usable pages
+        sched = Scheduler(cfg, 3)
+        a, b = _seq("a", 2), _seq("b", 2)
+        sched.add(a)
+        sched.add(b)
+        assert sched.schedule().kind == "prefill"   # each takes 1 page
+        a.append_token(5)
+        b.append_token(6)
+        # Next decode: both need a second page; only 0 free -> preempt b.
+        batch = sched.schedule()
+        assert batch.kind == "decode"
+        assert [s.request_id for s in batch.seqs] == ["a"]
+        assert sched.num_preemptions == 1
+        assert b.status == SequenceStatus.PREEMPTED
+        assert sched.waiting[0] is b
