@@ -1,0 +1,87 @@
+"""Serving throughput benchmark — prints ONE JSON line for the driver.
+
+Metric: steady-state decode tokens/sec/chip on TinyLlama-1.1B (BASELINE
+config 1's model) under continuous batching on whatever backend is default
+(the driver runs this on the real TPU chip).
+
+vs_baseline: the reference publishes no numbers (BASELINE.md "published: {}");
+the north star is ">= A100-class throughput per chip". We normalize against
+A100_VLLM_TOKS_PER_S, a representative vLLM decode throughput for this model
+class on one A100 at the same batch size.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_gpu_cluster_tpu.config import (
+    CacheConfig, EngineConfig, SchedulerConfig, get_model_config)
+from kubernetes_gpu_cluster_tpu.engine import LLMEngine, SamplingParams
+
+# Representative single-A100 vLLM decode throughput, ~1B-class model, batch 64.
+A100_VLLM_TOKS_PER_S = 6000.0
+
+BATCH = 64
+PROMPT_LEN = 128
+MAX_NEW_TOKENS = 512        # per sequence; bench stops earlier by wall budget
+WARMUP_WINDOWS = 4
+BENCH_WINDOWS = 24
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    model_name = "tinyllama-1.1b" if on_tpu else "debug-tiny"
+    cfg = EngineConfig(
+        model=get_model_config(model_name),
+        cache=CacheConfig(page_size=16,
+                          num_pages=BATCH * ((PROMPT_LEN + MAX_NEW_TOKENS) // 16 + 2) + 1),
+        scheduler=SchedulerConfig(
+            max_num_seqs=BATCH, max_prefill_tokens=2048,
+            decode_buckets=(BATCH,), prefill_buckets=(2048,)))
+    engine = LLMEngine(cfg, eos_token_id=None)
+
+    rng = np.random.default_rng(0)
+    vocab = cfg.model.vocab_size
+    params = SamplingParams(temperature=0.0, max_tokens=MAX_NEW_TOKENS)
+    for i in range(BATCH):
+        prompt = rng.integers(1, vocab, PROMPT_LEN).tolist()
+        engine.add_request(f"bench-{i}", prompt, params)
+
+    # Prefill all sequences (one or more ragged prefill steps), then warm up
+    # the windowed-decode program.
+    t0 = time.perf_counter()
+    while engine.scheduler.waiting:
+        engine.step()
+    prefill_s = time.perf_counter() - t0
+    for _ in range(WARMUP_WINDOWS):
+        engine.step()
+
+    t0 = time.perf_counter()
+    new_tokens = 0
+    for _ in range(BENCH_WINDOWS):
+        outs = engine.step()
+        if not outs:
+            break
+        new_tokens += sum(len(o.new_token_ids or []) for o in outs)
+    elapsed = time.perf_counter() - t0
+
+    toks_per_s = new_tokens / elapsed
+    result = {
+        "metric": f"decode_tokens_per_sec_per_chip[{model_name},B={BATCH},ctx={PROMPT_LEN}]",
+        "value": round(toks_per_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(toks_per_s / A100_VLLM_TOKS_PER_S, 3),
+        "backend": backend,
+        "prefill_tokens_per_sec": round(BATCH * PROMPT_LEN / prefill_s, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,12 @@ class SchedulerConfig:
     # prefill token counts are padded up to these buckets.
     decode_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
     prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048)
+    # Multi-step decode: run this many autoregressive decode steps inside one
+    # XLA program (sampled tokens feed back on-device via lax.scan), so host
+    # round-trips happen once per window, not once per token. Stop conditions
+    # are checked on the host after each window; tokens generated past a stop
+    # are discarded.
+    decode_window: int = 8
 
 
 @dataclasses.dataclass(frozen=True)

@@ -94,38 +94,82 @@ class LLMEngine:
         self._prefill_fn = self._build_prefill_fn()
         self._decode_fn = self._build_decode_fn()
         self.step_count = 0
+        # Speculative decode-window chain state (see step()).
+        self._inflight: Optional[dict] = None
+        self._deferred_release: list[Sequence] = []
 
     # -- jitted step programs ----------------------------------------------
 
     def _build_prefill_fn(self):
+        """Inputs arrive as TWO packed buffers (one int, one float) — each
+        host->device upload is a round trip on remote-attached TPUs, so the
+        step interface is packed tight: int_t [4, T] (tokens, seg_ids,
+        positions, slot_mapping), int_b [B, 2] (logits_indices, top_k),
+        float_b [B, 2] (temperature, top_p)."""
         cfg = self.model_config
         use_pallas = self.use_pallas
 
         @partial(jax.jit, donate_argnums=(1,))
-        def prefill_step(params, kv: KVCache, tokens, meta: PrefillMeta, key,
-                         temperature, top_k, top_p):
+        def prefill_step(params, kv: KVCache, int_t, int_b, float_b, key):
+            meta = PrefillMeta(seg_ids=int_t[1], positions=int_t[2],
+                               slot_mapping=int_t[3],
+                               logits_indices=int_b[:, 0])
             hidden, kv, _ = model_lib.forward_prefill(
-                params, cfg, tokens, meta, kv, use_pallas=use_pallas)
+                params, cfg, int_t[0], meta, kv, use_pallas=use_pallas)
             logits = model_lib.compute_logits(params, cfg, hidden)
-            next_tokens = sample_tokens(logits, key, temperature, top_k, top_p)
+            next_tokens = sample_tokens(logits, key, float_b[:, 0],
+                                        int_b[:, 1], float_b[:, 1])
             return next_tokens, kv
 
         return prefill_step
 
     def _build_decode_fn(self):
+        """Multi-step decode: W autoregressive steps inside one XLA program.
+        Sampled tokens feed back on-device through a lax.scan; per-sub-step
+        positions/slots/context-lens are recomputed from the page tables, so
+        only one host->device upload and one [B, W] download happen per
+        window. This is what keeps continuous batching fast when the host
+        round-trip is the bottleneck (and it always is: TPU decode steps are
+        ~ms, host syncs are not free anywhere)."""
         cfg = self.model_config
         use_pallas = self.use_pallas
+        W = self.config.scheduler.decode_window
+        ps = self.config.cache.page_size
 
         @partial(jax.jit, donate_argnums=(1,))
-        def decode_step(params, kv: KVCache, tokens, meta: DecodeMeta, key,
-                        temperature, top_k, top_p):
-            hidden, kv, _ = model_lib.forward_decode(
-                params, cfg, tokens, meta, kv, use_pallas=use_pallas)
-            logits = model_lib.compute_logits(params, cfg, hidden)
-            next_tokens = sample_tokens(logits, key, temperature, top_k, top_p)
-            return next_tokens, kv
+        def decode_window(params, kv: KVCache, tokens0, int_b, float_b, key):
+            # tokens0: [B] — separate so chained windows can feed the previous
+            # window's device-resident output column without a host roundtrip.
+            # int_b: [B, pps+2] = (positions, top_k, page_table...),
+            # float_b: [B, 2] = (temperature, top_p). Slots/context lens are
+            # recomputed per sub-step from positions + page tables.
+            positions0 = int_b[:, 0]
+            top_k = int_b[:, 1]
+            page_tables = int_b[:, 2:]
+            temperature = float_b[:, 0]
+            top_p = float_b[:, 1]
 
-        return decode_step
+            def substep(carry, i):
+                kv, tokens, pos = carry
+                page_idx = jnp.minimum(pos // ps, page_tables.shape[1] - 1)
+                page = jnp.take_along_axis(page_tables, page_idx[:, None],
+                                           axis=1)[:, 0]
+                m = DecodeMeta(positions=pos,
+                               slot_mapping=page * ps + pos % ps,
+                               page_tables=page_tables,
+                               context_lens=pos + 1)
+                hidden, kv, _ = model_lib.forward_decode(
+                    params, cfg, tokens, m, kv, use_pallas=use_pallas)
+                logits = model_lib.compute_logits(params, cfg, hidden)
+                next_tokens = sample_tokens(logits, jax.random.fold_in(key, i),
+                                            temperature, top_k, top_p)
+                return (kv, next_tokens, pos + 1), next_tokens
+
+            (kv, _, _), toks = jax.lax.scan(
+                substep, (kv, tokens0, positions0), jnp.arange(W))
+            return toks.T, kv    # [B, W]
+
+        return decode_window
 
     # -- public API ---------------------------------------------------------
 
@@ -136,61 +180,157 @@ class LLMEngine:
         self.scheduler.add(seq)
 
     def abort_request(self, request_id: str) -> bool:
+        # A sequence in the in-flight window still has device KV writes
+        # pending against its pages: finish it but defer the page release
+        # until the chain drains.
+        if self._inflight is not None:
+            for seq in self._inflight["batch"].seqs:
+                if seq.request_id == request_id and not seq.is_finished:
+                    seq.status = SequenceStatus.FINISHED
+                    seq.finish_reason = FinishReason.ABORT
+                    if seq in self.scheduler.running:
+                        self.scheduler.running.remove(seq)
+                    self._inflight["zombies"].add(request_id)
+                    self._deferred_release.append(seq)
+                    return True
         return self.scheduler.abort(request_id)
 
     def has_unfinished_requests(self) -> bool:
-        return self.scheduler.has_work()
+        # An in-flight window must be drained even if every sequence finished
+        # (its deferred page releases happen at drain time).
+        return self.scheduler.has_work() or self._inflight is not None
 
     def step(self) -> list[RequestOutput]:
-        """Run one engine iteration (one prefill or decode device step) and
-        return outputs for sequences that advanced."""
-        batch = self.scheduler.schedule()
-        if batch is None:
-            return []
-        self.step_count += 1
-        self._key, step_key = jax.random.split(self._key)
+        """Run one engine iteration and return outputs for sequences that
+        advanced.
 
-        if batch.kind == "prefill":
-            meta = PrefillMeta(
-                seg_ids=jnp.asarray(batch.seg_ids),
-                positions=jnp.asarray(batch.positions),
-                slot_mapping=jnp.asarray(batch.slot_mapping),
-                logits_indices=jnp.asarray(batch.logits_indices))
-            next_tokens, self.kv_cache = self._prefill_fn(
-                self.params, self.kv_cache, jnp.asarray(batch.tokens), meta,
-                step_key, jnp.asarray(batch.temperature),
-                jnp.asarray(batch.top_k), jnp.asarray(batch.top_p))
+        Decode windows are SPECULATIVELY CHAINED: before downloading window
+        w's tokens, window w+1 is dispatched with its input tokens taken from
+        w's device-resident output column — so the (expensive) device->host
+        download of w overlaps w+1's execution, and the device never idles
+        between windows. The chain breaks when a prefill is waiting or any
+        sequence finished (the already-dispatched successor then runs with
+        the finished rows as zombies; their pages are only released once the
+        chain drains, so in-flight KV writes never touch reused pages)."""
+        inflight = self._inflight
+        if inflight is None:
+            batch = self.scheduler.schedule()
+            if batch is None:
+                return []
+            self.step_count += 1
+            self._key, step_key = jax.random.split(self._key)
+            float_b = jnp.asarray(
+                np.stack([batch.temperature, batch.top_p], axis=1))
+            if batch.kind == "prefill":
+                int_t = jnp.asarray(np.stack(
+                    [batch.tokens, batch.seg_ids, batch.positions,
+                     batch.slot_mapping]))
+                int_b = jnp.asarray(np.stack(
+                    [batch.logits_indices, batch.top_k], axis=1))
+                next_tokens, self.kv_cache = self._prefill_fn(
+                    self.params, self.kv_cache, int_t, int_b, float_b, step_key)
+                return self._process_window(
+                    batch, np.asarray(next_tokens)[:, None], set(), defer=False)
+            inflight = self._dispatch_window(
+                batch, jnp.asarray(batch.tokens), batch.positions, float_b)
+
+        successor = None
+        if not self.scheduler.waiting and not inflight["zombies"]:
+            successor = self._advance_window(inflight)
+
+        toks = np.asarray(inflight["dev_out"])   # syncs; overlaps successor
+        self._inflight = successor
+        outputs = self._process_window(inflight["batch"], toks,
+                                       inflight["zombies"],
+                                       defer=successor is not None)
+        if successor is not None:
+            successor["zombies"].update(
+                s.request_id for s in inflight["batch"].seqs if s.is_finished)
         else:
-            meta = DecodeMeta(
-                positions=jnp.asarray(batch.positions),
-                slot_mapping=jnp.asarray(batch.slot_mapping),
-                page_tables=jnp.asarray(batch.page_tables),
-                context_lens=jnp.asarray(batch.context_lens))
-            next_tokens, self.kv_cache = self._decode_fn(
-                self.params, self.kv_cache, jnp.asarray(batch.tokens), meta,
-                step_key, jnp.asarray(batch.temperature),
-                jnp.asarray(batch.top_k), jnp.asarray(batch.top_p))
+            self._drain_deferred()
+        return outputs
 
-        next_tokens = np.asarray(next_tokens)  # the only device->host transfer
-        return self._process_outputs(batch, next_tokens)
+    def _dispatch_window(self, batch: ScheduledBatch, tokens_dev,
+                         positions: np.ndarray, float_b) -> dict:
+        int_b = jnp.asarray(np.concatenate(
+            [np.stack([positions, batch.top_k], axis=1), batch.page_tables],
+            axis=1))
+        self._key, step_key = jax.random.split(self._key)
+        dev_out, self.kv_cache = self._decode_fn(
+            self.params, self.kv_cache, tokens_dev, int_b, float_b, step_key)
+        return {"batch": batch, "dev_out": dev_out, "positions": positions,
+                "float_b": float_b, "zombies": set()}
 
-    def _process_outputs(self, batch: ScheduledBatch,
-                         next_tokens: np.ndarray) -> list[RequestOutput]:
+    def _advance_window(self, inflight: dict) -> Optional[dict]:
+        """Build + dispatch the speculative successor window: same batch
+        composition, positions advanced by W, pages grown to cover the new
+        window. Returns None (chain breaks) if pages can't be grown."""
+        W = self.config.scheduler.decode_window
+        ps = self.config.cache.page_size
+        batch = inflight["batch"]
+        new_positions = inflight["positions"] + W
+        # Grow page lists to cover the successor window's KV writes.
+        grows = []
+        total = 0
+        for s, seq in enumerate(batch.seqs):
+            last_pos = min(int(new_positions[s]) + W - 1,
+                           self.config.effective_max_len - 1)
+            need = cdiv(last_pos + 1, ps) - len(seq.pages)
+            if need > 0:
+                grows.append((s, seq, need))
+                total += need
+        if not self.scheduler.allocator.can_allocate(total):
+            return None
+        for s, seq, need in grows:
+            seq.pages.extend(self.scheduler.allocator.allocate(need))
+            batch.page_tables[s, :len(seq.pages)] = seq.pages
+        self.step_count += 1
+        return self._dispatch_window(batch, inflight["dev_out"][:, -1],
+                                     new_positions, inflight["float_b"])
+
+    def _process_window(self, batch: ScheduledBatch, next_tokens: np.ndarray,
+                        zombies: set, defer: bool) -> list[RequestOutput]:
+        """next_tokens: [B_pad, W]. Append window tokens per sequence until a
+        stop condition fires; tokens generated past the stop are discarded.
+        ``zombies`` (request ids finished in an earlier chained window) are
+        skipped; with ``defer`` the pages of newly finished sequences are held
+        until the chain drains (an in-flight window may still write to them).
+        """
         outputs = []
         for s, seq in enumerate(batch.seqs):
-            token = int(next_tokens[s])
-            seq.append_token(token)
-            reason = seq.check_stop(self.config.effective_max_len)
-            if reason is not None:
-                self.scheduler.finish(seq, reason)
+            if seq.request_id in zombies:
+                continue
+            new_tokens: list[int] = []
+            for token in next_tokens[s]:
+                token = int(token)
+                seq.append_token(token)
+                new_tokens.append(token)
+                reason = seq.check_stop(self.config.effective_max_len)
+                if reason is not None:
+                    if defer:
+                        seq.status = SequenceStatus.FINISHED
+                        seq.finish_reason = reason
+                        if seq in self.scheduler.running:
+                            self.scheduler.running.remove(seq)
+                        self._deferred_release.append(seq)
+                    else:
+                        self.scheduler.finish(seq, reason)
+                    break
             outputs.append(RequestOutput(
                 request_id=seq.request_id,
                 prompt_token_ids=seq.prompt_token_ids,
                 output_token_ids=list(seq.output_token_ids),
                 finished=seq.is_finished,
                 finish_reason=seq.finish_reason.value if seq.finish_reason else None,
-                new_token_ids=[token]))
+                new_token_ids=new_tokens))
         return outputs
+
+    def _drain_deferred(self) -> None:
+        for seq in self._deferred_release:
+            if seq.pages:
+                self.scheduler.allocator.free(seq.pages)
+                seq.pages = []
+        self._deferred_release.clear()
 
     # -- convenience --------------------------------------------------------
 

@@ -6,11 +6,13 @@ images and only exposed sizing knobs (``gpuMemoryUtilization``, ``maxModelLen``
 paged cache is native:
 
 - Device side: one K and one V array of shape
-  ``[num_layers, num_pages, page_size, num_kv_heads, head_dim]`` living in HBM.
-  Layout rationale (TPU): the last two dims (num_kv_heads*head_dim) flatten to a
-  lane-aligned vector; a page is the DMA unit the Pallas decode kernel streams
-  HBM->VMEM. A single stacked array per K/V keeps jit donation trivial
-  (the cache is donated every step, so updates alias in place — no copies).
+  ``[num_layers, num_pages, page_size, num_kv_heads * head_dim]`` living in
+  HBM. Layout rationale (TPU): the head dims are stored FLATTENED so the last
+  (lane) dimension is >=128-aligned — Mosaic requires DMA slices aligned to
+  the 128-lane tiling, and head_dim=64 models would violate it unflattened.
+  A page slice ``[page_size, n_kv*hd]`` is the DMA unit the Pallas decode
+  kernel streams HBM->VMEM. A single stacked array per K/V keeps jit donation
+  trivial (the cache is donated every step, so updates alias in place).
 - Host side: ``PageAllocator`` — a free-list allocator with optional
   copy-on-write-free refcounts, mirroring vLLM's block manager role. Page 0 is
   reserved as a scrap page: padding tokens write there so scatter updates need
@@ -38,7 +40,7 @@ SCRAP_PAGE = 0
 
 
 class KVCache(NamedTuple):
-    """Device-side paged KV pool. k/v: [L, P, page_size, n_kv, head_dim]."""
+    """Device-side paged KV pool. k/v: [L, P, page_size, n_kv * head_dim]."""
     k: jax.Array
     v: jax.Array
 
@@ -58,7 +60,8 @@ def allocate_kv_cache(
     sharding: Optional[jax.sharding.Sharding] = None,
 ) -> KVCache:
     dtype = jnp.dtype(cache.dtype) if cache.dtype else model.jnp_dtype
-    shape = (model.num_layers, num_pages, cache.page_size, model.num_kv_heads, model.head_dim)
+    shape = (model.num_layers, num_pages, cache.page_size,
+             model.num_kv_heads * model.head_dim)
     def mk():
         return jnp.zeros(shape, dtype=dtype)
     if sharding is not None:

@@ -224,17 +224,22 @@ class Scheduler:
     def _schedule_decode(self) -> Optional[ScheduledBatch]:
         if not self.running:
             return None
-        # Ensure every running seq has a page for its next token; preempt the
-        # youngest until the rest fit.
+        # Ensure every running seq has pages covering the whole multi-step
+        # decode window (the device writes W new KV entries before the host
+        # sees any token); preempt the youngest until the rest fit.
+        W = self.config.scheduler.decode_window
         scheduled: list[Sequence] = []
         idx = 0
         while idx < len(self.running):
             seq = self.running[idx]
-            pages_needed = cdiv(seq.num_tokens, self.page_size)
-            if pages_needed > len(seq.pages):
-                assert pages_needed == len(seq.pages) + 1
-                if self.allocator.can_allocate(1):
-                    seq.pages.extend(self.allocator.allocate(1))
+            # Window inputs occupy positions num_tokens-1 .. num_tokens+W-2;
+            # clamp to the model length cap (host truncates past-stop tokens).
+            last_pos = min(seq.num_tokens + W - 2, self.config.effective_max_len - 1)
+            pages_needed = cdiv(last_pos + 1, self.page_size)
+            grow = pages_needed - len(seq.pages)
+            if grow > 0:
+                if self.allocator.can_allocate(grow):
+                    seq.pages.extend(self.allocator.allocate(grow))
                 else:
                     if not self._preempt_youngest():
                         break
@@ -245,8 +250,11 @@ class Scheduler:
             return None
 
         B = _bucket(len(scheduled), self.decode_buckets)
-        max_pages = max(len(s.pages) for s in scheduled)
-        pages_bucket = next_power_of_2(max_pages)
+        # Static page-table width: sized for max_model_len once, so the jitted
+        # decode program never recompiles as contexts grow. Costless on the
+        # device side — the Pallas decode kernel streams only the valid pages;
+        # the table upload is B * pages_max * 4 bytes.
+        pages_bucket = cdiv(self.config.effective_max_len, self.page_size)
         tokens = np.zeros(B, np.int32)
         positions = np.zeros(B, np.int32)
         slot_mapping = np.zeros(B, np.int32)

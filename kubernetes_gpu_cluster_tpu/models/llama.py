@@ -32,7 +32,7 @@ from ..config import ModelConfig
 from ..engine.kv_cache import KVCache
 from ..ops.rope import apply_rope, rope_cos_sin
 from ..ops.attention import (
-    write_kv_pages,
+    write_kv_pages_all,
     ragged_prefill_attention,
     paged_decode_attention,
 )
@@ -208,13 +208,27 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array, kv: KVCache,
                 positions: jax.Array, attn_fn,
                 layer_slice=None,
                 tp_axis: Optional[str] = None,
-                ep_axis: Optional[str] = None) -> tuple[jax.Array, KVCache]:
-    """Scan the layer body over stacked weights. attn_fn(q, k, v, k_pool, v_pool)
-    -> (attn_out, new_k_pool, new_v_pool) with k/v already RoPE'd.
+                ep_axis: Optional[str] = None,
+                ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Scan the layer body over stacked weights.
+
+    The KV pool enters the scan READ-ONLY (sliced per layer as xs); each
+    layer's freshly projected K/V come out as scan ys, and the caller commits
+    them to the pool in ONE donated scatter after the scan
+    (ops.attention.write_kv_pages_all). Threading the pool through the scan
+    as carry/ys would force XLA to copy the whole pool every step.
+
+    attn_fn(lp, q, k, v, k_pool_l, v_pool_l) -> attn_out, where the pool
+    slices hold tokens written in PREVIOUS steps only (attention folds the
+    current step's k/v in directly).
+
     ``layer_slice`` restricts to a contiguous [start, stop) layer range.
     ``tp_axis``/``ep_axis`` name manual mesh axes when running inside
     shard_map (parallel/pp.py); under GSPMD they stay None and the SPMD
-    partitioner inserts the equivalent collectives."""
+    partitioner inserts the equivalent collectives.
+
+    Returns (h, k_all, v_all) with k_all/v_all: [L, T, n_kv_local, hd].
+    """
     layers = params["layers"]
     if layer_slice is not None:
         start, stop = layer_slice
@@ -226,7 +240,7 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array, kv: KVCache,
         resid = h
         x = rms_norm(h, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, x, positions)
-        attn_out, k_pool, v_pool = attn_fn(lp, q, k, v, k_pool, v_pool)
+        attn_out = attn_fn(lp, q, k, v, k_pool, v_pool)
         attn_out = attn_out.reshape(x.shape[0], -1)
         o = jnp.dot(attn_out, lp["wo"], preferred_element_type=jnp.float32)
         if tp_axis is not None:  # row-sharded wo: partial sums over local heads
@@ -235,10 +249,10 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array, kv: KVCache,
         resid = h
         x = rms_norm(h, lp["post_attn_norm"], cfg.rms_norm_eps)
         h = resid + _mlp_block(lp, cfg, x, tp_axis=tp_axis, ep_axis=ep_axis)
-        return h, (k_pool, v_pool)
+        return h, (k, v)
 
-    h, (new_k, new_v) = jax.lax.scan(body, h, (layers, kv.k, kv.v))
-    return h, KVCache(k=new_k, v=new_v)
+    h, (k_all, v_all) = jax.lax.scan(body, h, (layers, kv.k, kv.v))
+    return h, k_all, v_all
 
 
 def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
@@ -254,15 +268,20 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
     h = params["embed"][tokens] if hidden_in is None else hidden_in
 
     def attn_fn(lp, q, k, v, k_pool, v_pool):
-        k_pool, v_pool = write_kv_pages(k_pool, v_pool, k, v, meta.slot_mapping)
-        out = ragged_prefill_attention(q, k, v, meta.seg_ids, meta.positions,
-                                       scale, use_pallas=use_pallas)
-        return out, k_pool, v_pool
+        # Prefill attends within the in-batch k/v only (each sequence's whole
+        # prompt is in this batch); the pool is written post-scan for decode.
+        return ragged_prefill_attention(q, k, v, meta.seg_ids, meta.positions,
+                                        scale, use_pallas=use_pallas)
 
-    h, kv = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn, layer_slice,
-                        tp_axis=tp_axis, ep_axis=ep_axis)
+    h, k_all, v_all = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn,
+                                  layer_slice, tp_axis=tp_axis, ep_axis=ep_axis)
+    if layer_slice is not None:
+        kv = KVCache(k=kv.k[layer_slice[0]:layer_slice[1]],
+                     v=kv.v[layer_slice[0]:layer_slice[1]])
+    new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
+                                         meta.slot_mapping))
     selected = h[meta.logits_indices]
-    return rms_norm(selected, params["final_norm"], cfg.rms_norm_eps), kv, h
+    return rms_norm(selected, params["final_norm"], cfg.rms_norm_eps), new_kv, h
 
 
 def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
@@ -277,14 +296,20 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
     h = params["embed"][tokens] if hidden_in is None else hidden_in
 
     def attn_fn(lp, q, k, v, k_pool, v_pool):
-        k_pool, v_pool = write_kv_pages(k_pool, v_pool, k, v, meta.slot_mapping)
-        out = paged_decode_attention(q, k_pool, v_pool, meta.page_tables,
-                                     meta.context_lens, scale, use_pallas=use_pallas)
-        return out, k_pool, v_pool
+        # Pool holds positions 0..ctx-2; this step's k/v fold in directly and
+        # are committed to the pool in one post-scan scatter.
+        return paged_decode_attention(q, k_pool, v_pool, meta.page_tables,
+                                      meta.context_lens, k, v, scale,
+                                      use_pallas=use_pallas)
 
-    h, kv = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn, layer_slice,
-                        tp_axis=tp_axis, ep_axis=ep_axis)
-    return rms_norm(h, params["final_norm"], cfg.rms_norm_eps), kv, h
+    h, k_all, v_all = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn,
+                                  layer_slice, tp_axis=tp_axis, ep_axis=ep_axis)
+    if layer_slice is not None:
+        kv = KVCache(k=kv.k[layer_slice[0]:layer_slice[1]],
+                     v=kv.v[layer_slice[0]:layer_slice[1]])
+    new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
+                                         meta.slot_mapping))
+    return rms_norm(h, params["final_norm"], cfg.rms_norm_eps), new_kv, h
 
 
 def compute_logits(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:

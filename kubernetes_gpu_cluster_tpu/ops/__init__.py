@@ -1,6 +1,6 @@
 from .rope import apply_rope, rope_cos_sin  # noqa: F401
 from .attention import (  # noqa: F401
-    write_kv_pages,
+    write_kv_pages_all,
     paged_decode_attention,
     ragged_prefill_attention,
 )

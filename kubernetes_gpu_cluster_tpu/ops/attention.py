@@ -39,22 +39,31 @@ def _on_tpu(x: jax.Array | None = None) -> bool:
 # KV page writes
 # ---------------------------------------------------------------------------
 
-def write_kv_pages(k_cache_l: jax.Array, v_cache_l: jax.Array,
-                   k_new: jax.Array, v_new: jax.Array,
-                   slot_mapping: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Scatter new K/V vectors into the page pool for one layer.
+def write_kv_pages_all(kv_k: jax.Array, kv_v: jax.Array,
+                       k_all: jax.Array, v_all: jax.Array,
+                       slot_mapping: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Scatter every layer's new K/V vectors into the page pool at once.
 
-    k_cache_l/v_cache_l: [P, page_size, n_kv, hd] (this layer's pool)
-    k_new/v_new:         [T, n_kv, hd]
-    slot_mapping:        [T] int32 flat slot = page_id * page_size + offset.
-                         Padding tokens carry slots inside the scrap page 0.
+    kv_k/kv_v:    [L, P, page_size, n_kv*hd] (the whole pool, heads flattened)
+    k_all/v_all:  [L, T, n_kv, hd] (stacked per-layer new entries, the ys of
+                  the layer scan)
+    slot_mapping: [T] int32 flat slot = page_id * page_size + offset.
+                  Padding tokens carry slots inside the scrap page 0.
+
+    CRITICAL perf property: this runs OUTSIDE the layer scan on the donated
+    pool, so XLA performs it in place (~0 cost). Threading the pool through
+    the scan as carry/ys forces a full pool copy per step (~4 ms per 200 MB
+    pool on v5e) — that architecture was measured and rejected; attention
+    instead reads the pool pre-write and takes the current token's K/V
+    separately (see paged_decode_attention).
     """
-    P, ps, n_kv, hd = k_cache_l.shape
-    flat_k = k_cache_l.reshape(P * ps, n_kv, hd)
-    flat_v = v_cache_l.reshape(P * ps, n_kv, hd)
-    flat_k = flat_k.at[slot_mapping].set(k_new.astype(flat_k.dtype))
-    flat_v = flat_v.at[slot_mapping].set(v_new.astype(flat_v.dtype))
-    return flat_k.reshape(k_cache_l.shape), flat_v.reshape(v_cache_l.shape)
+    L, P, ps, kd = kv_k.shape
+    T = k_all.shape[1]
+    fk = kv_k.reshape(L, P * ps, kd)
+    fv = kv_v.reshape(L, P * ps, kd)
+    fk = fk.at[:, slot_mapping].set(k_all.reshape(L, T, kd).astype(kv_k.dtype))
+    fv = fv.at[:, slot_mapping].set(v_all.reshape(L, T, kd).astype(kv_v.dtype))
+    return fk.reshape(kv_k.shape), fv.reshape(kv_v.shape)
 
 
 # ---------------------------------------------------------------------------
@@ -99,18 +108,25 @@ def ragged_prefill_attention_xla(
 
 def paged_decode_attention_xla(
     q: jax.Array,            # [B, n_heads, hd] (post-RoPE)
-    k_cache_l: jax.Array,    # [P, page_size, n_kv, hd]
-    v_cache_l: jax.Array,    # [P, page_size, n_kv, hd]
+    k_cache_l: jax.Array,    # [P, page_size, n_kv*hd] (heads flattened)
+    v_cache_l: jax.Array,    # [P, page_size, n_kv*hd]
     page_tables: jax.Array,  # [B, pages_per_seq] int32 page ids (pad = 0/scrap)
     context_lens: jax.Array, # [B] int32 number of valid tokens (incl. current)
+    k_cur: jax.Array,        # [B, n_kv, hd] current token's K (not yet in pool)
+    v_cur: jax.Array,        # [B, n_kv, hd] current token's V
     scale: float,
 ) -> jax.Array:
-    """Gather-then-attend reference implementation. The gather materializes
-    [B, pages_per_seq*page_size] worth of K/V — HBM-bandwidth-bound, which is
-    what the Pallas kernel (pallas_paged_decode) avoids by streaming pages
-    through VMEM with online softmax."""
+    """Gather-then-attend reference implementation.
+
+    The pool holds positions 0..context_len-2; the current token's K/V arrive
+    separately because pool writes are deferred to one post-scan scatter
+    (write_kv_pages_all). The gather materializes [B, pages_per_seq*page_size]
+    worth of K/V — HBM-bandwidth-bound, which is what the Pallas kernel
+    (pallas_paged_decode) avoids by streaming only valid pages through VMEM
+    with online softmax."""
     B, n_heads, hd = q.shape
-    P, ps, n_kv, _ = k_cache_l.shape
+    P, ps, _ = k_cache_l.shape
+    n_kv = k_cur.shape[1]
     pages_per_seq = page_tables.shape[1]
     L = pages_per_seq * ps
     q_per_kv = n_heads // n_kv
@@ -120,11 +136,14 @@ def paged_decode_attention_xla(
 
     qg = (q.astype(jnp.float32) * scale).reshape(B, n_kv, q_per_kv, hd)
     scores = jnp.einsum("bkgh,blkh->bkgl", qg, k_seq)         # [B, n_kv, g, L]
-    valid = jnp.arange(L)[None, :] < context_lens[:, None]    # [B, L]
+    # Pool rows valid up to context_len-1 (the current token is separate).
+    valid = jnp.arange(L)[None, :] < (context_lens - 1)[:, None]
     scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+    cur = jnp.einsum("bkgh,bkh->bkg", qg, k_cur.astype(jnp.float32))
+    scores = jnp.concatenate([scores, cur[..., None]], axis=-1)  # [B,n_kv,g,L+1]
     probs = jax.nn.softmax(scores, axis=-1)
-    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
-    out = jnp.einsum("bkgl,blkh->bkgh", probs, v_seq)
+    out = (jnp.einsum("bkgl,blkh->bkgh", probs[..., :L], v_seq)
+           + probs[..., L:] * v_cur.astype(jnp.float32)[:, :, None, :])
     return out.reshape(B, n_heads, hd).astype(q.dtype)
 
 
@@ -145,15 +164,15 @@ def ragged_prefill_attention(q, k, v, seg_ids, positions, scale, *, use_pallas=N
 
 
 def paged_decode_attention(q, k_cache_l, v_cache_l, page_tables, context_lens,
-                           scale, *, use_pallas=None):
+                           k_cur, v_cur, scale, *, use_pallas=None):
     if use_pallas is None:
         use_pallas = _on_tpu()
     if use_pallas:
         try:
             from .pallas.paged_decode import pallas_paged_decode
             return pallas_paged_decode(q, k_cache_l, v_cache_l, page_tables,
-                                       context_lens, scale)
+                                       context_lens, k_cur, v_cur, scale)
         except Exception as e:  # pragma: no cover - fallback safety
             logger.warning("pallas decode unavailable (%s); falling back to XLA", e)
     return paged_decode_attention_xla(q, k_cache_l, v_cache_l, page_tables,
-                                      context_lens, scale)
+                                      context_lens, k_cur, v_cur, scale)

@@ -1,0 +1,186 @@
+"""Paged decode attention as a Pallas TPU kernel.
+
+One grid step per sequence: stream that sequence's valid KV pages HBM->VMEM
+with double-buffered async DMA, accumulate flash-style online softmax in
+fp32, then fold in the current token's K/V (which are not yet in the pool —
+pool writes are deferred to one post-scan scatter, see
+ops.attention.write_kv_pages_all). Only ``ceil((ctx-1)/page_size)`` pages per
+sequence move on the bus — the XLA fallback reads the full padded page table.
+
+Replaces vLLM's CUDA PagedAttention kernel (the engine the reference deployed
+via Helm, reference ``values-01-minimal-example8.yaml:28-38``) with a
+TPU-native design per BASELINE.json's north star.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_tables_ref,   # [B*pps] int32 (flattened)
+    context_lens_ref,  # [B] int32 (incl. current token)
+    layer_ref,         # [1] int32 layer index into the pool
+    # blocked inputs
+    q_ref,             # [1, nh, hd] VMEM
+    k_hbm,             # [L, P, ps, n_kv*hd] ANY/HBM (full pool, heads flat)
+    v_hbm,             # [L, P, ps, n_kv*hd]
+    k_cur_ref,         # [1, n_kv, hd] VMEM
+    v_cur_ref,         # [1, n_kv, hd] VMEM
+    # output
+    out_ref,           # [1, nh, hd] VMEM
+    # scratch
+    k_buf,             # [2, ps, n_kv*hd] VMEM
+    v_buf,             # [2, ps, n_kv*hd]
+    sems,              # DMA sems [2, 2]
+    *,
+    scale: float,
+    pages_per_seq: int,
+    page_size: int,
+    num_kv: int,
+    q_per_kv: int,
+    head_dim: int,
+):
+    b = pl.program_id(0)
+    layer = layer_ref[0]
+    ctx_pool = jnp.maximum(context_lens_ref[b] - 1, 0)  # tokens already in pool
+    n_pages = pl.cdiv(ctx_pool, page_size)
+
+    def dma(buf, hbm, slot, j, sem_idx):
+        page = page_tables_ref[b * pages_per_seq + j]
+        return pltpu.make_async_copy(
+            hbm.at[layer, page], buf.at[slot], sems.at[slot, sem_idx])
+
+    @pl.when(n_pages > 0)
+    def _():
+        dma(k_buf, k_hbm, 0, 0, 0).start()
+        dma(v_buf, v_hbm, 0, 0, 1).start()
+
+    q = q_ref[0].astype(jnp.float32) * scale            # [nh, hd]
+
+    neg = jnp.float32(-1e30)
+    init = []
+    for kh in range(num_kv):
+        init.append(jnp.full((q_per_kv, 1), neg, jnp.float32))   # m
+        init.append(jnp.zeros((q_per_kv, 1), jnp.float32))       # l
+        init.append(jnp.zeros((q_per_kv, head_dim), jnp.float32))  # acc
+    init = tuple(init)
+
+    def body(j, carry):
+        slot = jax.lax.rem(j, 2)
+        nxt = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < n_pages)
+        def _():
+            dma(k_buf, k_hbm, nxt, j + 1, 0).start()
+            dma(v_buf, v_hbm, nxt, j + 1, 1).start()
+
+        dma(k_buf, k_hbm, slot, j, 0).wait()
+        dma(v_buf, v_hbm, slot, j, 1).wait()
+
+        valid = (jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+                 < (ctx_pool - j * page_size))           # [1, ps]
+        new = []
+        for kh in range(num_kv):
+            m, l, acc = carry[3*kh], carry[3*kh+1], carry[3*kh+2]
+            qk = q[kh*q_per_kv:(kh+1)*q_per_kv]          # [g, hd]
+            kk = k_buf[slot, :, kh*head_dim:(kh+1)*head_dim].astype(jnp.float32)  # [ps, hd]
+            vv = v_buf[slot, :, kh*head_dim:(kh+1)*head_dim].astype(jnp.float32)
+            s = jax.lax.dot_general(qk, kk, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)  # [g, ps]
+            s = jnp.where(valid, s, neg)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            p = jnp.where(valid, p, 0.0)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc = acc * alpha + jax.lax.dot_general(
+                p, vv, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)       # [g, hd]
+            new += [m_new, l, acc]
+        return tuple(new)
+
+    carry = jax.lax.fori_loop(0, n_pages, body, init)
+
+    # Fold in the current token (always valid) and finalize.
+    for kh in range(num_kv):
+        m, l, acc = carry[3*kh], carry[3*kh+1], carry[3*kh+2]
+        qk = q[kh*q_per_kv:(kh+1)*q_per_kv]              # [g, hd]
+        kc = k_cur_ref[0, kh, :].astype(jnp.float32)     # [hd]
+        vc = v_cur_ref[0, kh, :].astype(jnp.float32)
+        s = jnp.sum(qk * kc[None, :], axis=-1, keepdims=True)  # [g, 1]
+        m_new = jnp.maximum(m, s)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + p
+        acc = acc * alpha + p * vc[None, :]
+        out_ref[0, kh*q_per_kv:(kh+1)*q_per_kv, :] = (
+            acc / l).astype(out_ref.dtype)
+
+
+def pallas_paged_decode(q, k_pool, v_pool, page_tables, context_lens,
+                        k_cur, v_cur, scale, *, layer=None, interpret=False):
+    """q: [B, nh, hd]; k_pool/v_pool: [P, ps, n_kv*hd] (one layer, heads
+    flattened) or [L, P, ps, n_kv*hd] with ``layer`` the dynamic layer index;
+    page_tables: [B, pages_per_seq]; context_lens: [B] (incl. current token);
+    k_cur/v_cur: [B, n_kv, hd]. Returns [B, nh, hd]."""
+    if k_pool.shape[-1] % 128 != 0:
+        # Mosaic DMA slices must be 128-lane aligned; raise at TRACE time so
+        # the dispatcher's fallback catches it (the Mosaic failure itself only
+        # surfaces at compile time, after tracing succeeded).
+        raise ValueError(
+            f"paged pool lane dim {k_pool.shape[-1]} (n_kv*head_dim) must be "
+            f"a multiple of 128 for the Pallas decode kernel")
+    if k_pool.ndim == 3:          # one layer's pool [P, ps, n_kv*hd]
+        k_pool = k_pool[None]
+        v_pool = v_pool[None]
+        layer = jnp.zeros((1,), jnp.int32)
+    elif layer is None:
+        raise ValueError("layer index required for stacked pool")
+    else:
+        layer = jnp.asarray(layer, jnp.int32).reshape(1)
+
+    B, nh, hd = q.shape
+    L, P, ps, _ = k_pool.shape
+    n_kv = k_cur.shape[1]
+    pps = page_tables.shape[1]
+    g = nh // n_kv
+
+    kernel = functools.partial(
+        _decode_kernel, scale=float(scale), pages_per_seq=pps, page_size=ps,
+        num_kv=n_kv, q_per_kv=g, head_dim=hd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, nh, hd), lambda b, *_: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, n_kv, hd), lambda b, *_: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_kv, hd), lambda b, *_: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, nh, hd), lambda b, *_: (b, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, n_kv * hd), k_pool.dtype),
+            pltpu.VMEM((2, ps, n_kv * hd), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, nh, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_tables.reshape(-1), context_lens, layer, q, k_pool, v_pool,
+      k_cur, v_cur)

@@ -82,7 +82,7 @@ def param_pp_specs(cfg: ModelConfig) -> dict:
     return specs
 
 
-KV_PP_SPEC = P("pp", None, None, "tp", None)
+KV_PP_SPEC = P("pp", None, None, "tp")  # [L, P, ps, n_kv*hd], heads over tp
 
 
 def validate_pp_mesh(mesh: Mesh, cfg: ModelConfig) -> None:

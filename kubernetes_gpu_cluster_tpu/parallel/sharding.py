@@ -94,11 +94,12 @@ def param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict[str, Any]:
 
 
 def kv_cache_sharding(mesh: Mesh, cfg: ModelConfig) -> NamedSharding:
-    """Paged pool [L, P, page_size, n_kv, head_dim]: shard kv heads over tp
-    when divisible (each device streams only its heads' pages through VMEM)."""
+    """Paged pool [L, P, page_size, n_kv*head_dim]: shard the flattened head
+    dim over tp when kv heads divide it (the contiguous chunks then coincide
+    with kv-head groups, so each device streams only its heads' pages)."""
     tp = _axis(mesh, "tp")
     kv_tp = "tp" if cfg.num_kv_heads % tp == 0 else None
-    return _ns(mesh, None, None, None, kv_tp, None)
+    return _ns(mesh, None, None, None, kv_tp)
 
 
 def data_shardings(mesh: Mesh) -> NamedSharding:

@@ -123,3 +123,29 @@ def test_prompt_too_long_rejected():
     eng = make_engine()
     with pytest.raises(ValueError, match="exceeds"):
         eng.add_request("x", list(range(1000)))
+
+
+class TestDecodeWindowEquivalence:
+    def test_windowed_decode_matches_single_step(self):
+        """Greedy generation must be identical for decode_window=1 and =4:
+        the on-device autoregressive scan is semantically the same loop."""
+        import jax
+        from kubernetes_gpu_cluster_tpu.models import llama as model_lib
+        base = dict(
+            model=get_model_config("debug-tiny"),
+            cache=CacheConfig(page_size=4, num_pages=64))
+        params = model_lib.init_params(base["model"], jax.random.key(7))
+        prompts = [[1, 5, 9, 2], [3, 3, 7]]
+        sp = SamplingParams(temperature=0.0, max_tokens=10)
+
+        outs = {}
+        for w in (1, 4):
+            cfg = EngineConfig(
+                scheduler=SchedulerConfig(
+                    max_num_seqs=4, max_prefill_tokens=64,
+                    decode_buckets=(2, 4), prefill_buckets=(16, 32),
+                    decode_window=w),
+                **base)
+            eng = LLMEngine(cfg, params=params)
+            outs[w] = [o.output_token_ids for o in eng.generate(prompts, sp)]
+        assert outs[1] == outs[4]

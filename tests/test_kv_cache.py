@@ -53,5 +53,5 @@ def test_kv_cache_shape():
     model = get_model_config("debug-tiny")
     cache = CacheConfig(page_size=8)
     kv = allocate_kv_cache(model, cache, 16)
-    assert kv.k.shape == (model.num_layers, 16, 8, model.num_kv_heads, model.head_dim)
+    assert kv.k.shape == (model.num_layers, 16, 8, model.num_kv_heads * model.head_dim)
     assert kv.num_pages == 16 and kv.page_size == 8

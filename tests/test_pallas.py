@@ -1,0 +1,98 @@
+"""Pallas kernels vs the XLA reference implementations (interpret mode on the
+CPU mesh; the same kernels compile on real TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_gpu_cluster_tpu.ops.attention import (
+    paged_decode_attention_xla, ragged_prefill_attention_xla)
+from kubernetes_gpu_cluster_tpu.ops.pallas.flash_prefill import flash_ragged_prefill
+from kubernetes_gpu_cluster_tpu.ops.pallas.paged_decode import pallas_paged_decode
+
+
+class TestPagedDecodeKernel:
+    @pytest.mark.parametrize("nh,nkv,hd,ps", [(4, 2, 32, 8), (8, 8, 64, 16)])
+    def test_matches_xla(self, nh, nkv, hd, ps):
+        B, P, pps = 4, 9, 3
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((B, nh, hd)), jnp.float32)
+        k_pool = jnp.asarray(rng.standard_normal((P, ps, nkv * hd)), jnp.float32)
+        v_pool = jnp.asarray(rng.standard_normal((P, ps, nkv * hd)), jnp.float32)
+        k_cur = jnp.asarray(rng.standard_normal((B, nkv, hd)), jnp.float32)
+        v_cur = jnp.asarray(rng.standard_normal((B, nkv, hd)), jnp.float32)
+        page_tables = jnp.asarray(
+            rng.permutation(np.arange(1, 1 + B * pps)).reshape(B, pps), jnp.int32)
+        # Heterogeneous contexts incl. ctx=1 (empty pool) and a padding row.
+        context_lens = jnp.asarray([1, ps + 2, 2 * ps, 0], jnp.int32)
+
+        ref = paged_decode_attention_xla(q, k_pool, v_pool, page_tables,
+                                         context_lens, k_cur, v_cur, 0.125)
+        got = pallas_paged_decode(q, k_pool, v_pool, page_tables,
+                                  context_lens, k_cur, v_cur, 0.125,
+                                  interpret=True)
+        # Padding row (ctx=0) is garbage in both paths; compare real rows.
+        np.testing.assert_allclose(np.asarray(got)[:3], np.asarray(ref)[:3],
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_stacked_pool_layer_index(self):
+        B, P, ps, nkv, nh, hd, pps, L = 2, 5, 8, 2, 4, 32, 2, 3
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.standard_normal((B, nh, hd)), jnp.float32)
+        pool_k = jnp.asarray(rng.standard_normal((L, P, ps, nkv * hd)), jnp.float32)
+        pool_v = jnp.asarray(rng.standard_normal((L, P, ps, nkv * hd)), jnp.float32)
+        k_cur = jnp.asarray(rng.standard_normal((B, nkv, hd)), jnp.float32)
+        v_cur = jnp.asarray(rng.standard_normal((B, nkv, hd)), jnp.float32)
+        pt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+        cl = jnp.asarray([ps + 1, 5], jnp.int32)
+        for layer in range(L):
+            ref = paged_decode_attention_xla(q, pool_k[layer], pool_v[layer],
+                                             pt, cl, k_cur, v_cur, 0.2)
+            got = pallas_paged_decode(q, pool_k, pool_v, pt, cl, k_cur, v_cur,
+                                      0.2, layer=layer, interpret=True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+
+
+class TestFlashPrefillKernel:
+    @pytest.mark.parametrize("T,block", [(64, 16), (128, 128)])
+    def test_matches_xla(self, T, block):
+        nh, nkv, hd = 4, 2, 32
+        rng = np.random.default_rng(2)
+        q = jnp.asarray(rng.standard_normal((T, nh, hd)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((T, nkv, hd)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((T, nkv, hd)), jnp.float32)
+        # Three segments + trailing padding.
+        lens = [T // 4, T // 3, T // 4]
+        seg = np.full(T, -1, np.int32)
+        pos = np.zeros(T, np.int32)
+        i = 0
+        for s, n in enumerate(lens):
+            seg[i:i+n] = s
+            pos[i:i+n] = np.arange(n)
+            i += n
+        seg_ids = jnp.asarray(seg)
+        positions = jnp.asarray(pos)
+
+        ref = ragged_prefill_attention_xla(q, k, v, seg_ids, positions, 0.125)
+        got = flash_ragged_prefill(q, k, v, seg_ids, positions, 0.125,
+                                   block_q=block, block_k=block, interpret=True)
+        real = seg >= 0
+        np.testing.assert_allclose(np.asarray(got)[real], np.asarray(ref)[real],
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gqa_head_mapping(self):
+        """Each q head must read its own kv head (h // g), not head 0."""
+        T, nh, nkv, hd = 32, 4, 4, 32   # distinct kv per q head
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.standard_normal((T, nh, hd)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((T, nkv, hd)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((T, nkv, hd)), jnp.float32)
+        seg_ids = jnp.zeros(T, jnp.int32)
+        positions = jnp.arange(T, dtype=jnp.int32)
+        ref = ragged_prefill_attention_xla(q, k, v, seg_ids, positions, 0.2)
+        got = flash_ragged_prefill(q, k, v, seg_ids, positions, 0.2,
+                                   block_q=16, block_k=16, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)

@@ -10,14 +10,15 @@ from kubernetes_gpu_cluster_tpu.engine.sequence import (
     FinishReason, Sequence, SequenceStatus)
 
 
-def _cfg(num_pages=8, page_size=4, max_num_seqs=4):
+def _cfg(num_pages=8, page_size=4, max_num_seqs=4, decode_window=1):
     return EngineConfig(
         model=get_model_config("debug-tiny"),
         cache=CacheConfig(page_size=page_size, num_pages=num_pages),
         scheduler=SchedulerConfig(max_num_seqs=max_num_seqs,
                                   max_prefill_tokens=64,
                                   decode_buckets=(1, 2, 4),
-                                  prefill_buckets=(16, 32, 64)))
+                                  prefill_buckets=(16, 32, 64),
+                                  decode_window=decode_window))
 
 
 def _seq(rid, n_prompt, max_tokens=64):
@@ -123,3 +124,19 @@ class TestPreemptionInDecode:
         assert sched.num_preemptions == 1
         assert b.status == SequenceStatus.PREEMPTED
         assert sched.waiting[0] is b
+
+
+class TestDecodeWindow:
+    def test_window_preallocates_pages(self):
+        """With decode_window=W the decode schedule must grow each sequence's
+        page list to cover all W on-device KV writes up front."""
+        cfg = _cfg(num_pages=9, page_size=4, decode_window=6)
+        sched = Scheduler(cfg, 9)
+        seq = _seq("w", 4)       # 1 page for the prompt
+        sched.add(seq)
+        assert sched.schedule().kind == "prefill"
+        seq.append_token(5)
+        batch = sched.schedule()
+        assert batch.kind == "decode"
+        # positions 4..9 -> 10 slots -> 3 pages of 4
+        assert len(seq.pages) == 3
