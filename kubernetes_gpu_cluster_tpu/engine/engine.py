@@ -60,7 +60,7 @@ class LLMEngine:
         self.model_config = config.model
         self.eos_token_id = eos_token_id
         self.mesh = mesh
-        self.use_pallas = use_pallas
+        self.use_pallas = self._resolve_use_pallas(use_pallas)
         self._key = jax.random.key(config.seed)
 
         hbm_free = _device_free_memory()
@@ -97,6 +97,33 @@ class LLMEngine:
         # Speculative decode-window chain state (see step()).
         self._inflight: Optional[dict] = None
         self._deferred_release: list[Sequence] = []
+
+    def _resolve_use_pallas(self, use_pallas: Optional[bool]) -> bool:
+        """Decide the kernel path ONCE, at init, from static facts — backend,
+        mesh sharding, lane alignment. Mosaic constraint violations surface at
+        jit-COMPILE time, after tracing succeeded, so the dispatchers' trace-
+        time try/except cannot catch them; deciding eagerly avoids a crash
+        deep in the first step."""
+        if use_pallas is not None:
+            return use_pallas
+        if jax.default_backend() != "tpu":
+            return False
+        cfg = self.model_config
+        tp = self.mesh.shape.get("tp", 1) if self.mesh is not None else 1
+        lane = (cfg.num_kv_heads * cfg.head_dim) // tp
+        if lane % 128 != 0:
+            logger.warning(
+                "Pallas kernels disabled: per-shard KV lane dim %d (n_kv*hd/tp)"
+                " is not 128-aligned; using XLA attention", lane)
+            return False
+        if self.mesh is not None:
+            # pallas_call under GSPMD auto-partitioning is not supported for
+            # the paged pool layout; the sharded path uses XLA attention
+            # (shard_map-wrapped Pallas is the planned upgrade).
+            logger.warning("Pallas kernels disabled under GSPMD mesh; "
+                           "using XLA attention")
+            return False
+        return True
 
     # -- jitted step programs ----------------------------------------------
 
@@ -135,6 +162,7 @@ class LLMEngine:
         use_pallas = self.use_pallas
         W = self.config.scheduler.decode_window
         ps = self.config.cache.page_size
+        max_len = self.config.effective_max_len
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode_window(params, kv: KVCache, tokens0, int_b, float_b, key):
@@ -151,13 +179,21 @@ class LLMEngine:
 
             def substep(carry, i):
                 kv, tokens, pos = carry
-                page_idx = jnp.minimum(pos // ps, page_tables.shape[1] - 1)
+                # Window substeps past the model length cap produce tokens the
+                # host discards — but their KV writes still happen on device.
+                # Route them to the scrap page (page 0) instead of clamping
+                # into the sequence's real pages, where the write would wrap
+                # (pos % ps) and overwrite earlier KV.
+                pos_c = jnp.minimum(pos, max_len - 1)
+                page_idx = pos_c // ps
                 page = jnp.take_along_axis(page_tables, page_idx[:, None],
                                            axis=1)[:, 0]
-                m = DecodeMeta(positions=pos,
-                               slot_mapping=page * ps + pos % ps,
+                in_range = pos < max_len
+                slot = jnp.where(in_range, page * ps + pos_c % ps, pos % ps)
+                m = DecodeMeta(positions=pos_c,
+                               slot_mapping=slot,
                                page_tables=page_tables,
-                               context_lens=pos + 1)
+                               context_lens=pos_c + 1)
                 hidden, kv, _ = model_lib.forward_decode(
                     params, cfg, tokens, m, kv, use_pallas=use_pallas)
                 logits = model_lib.compute_logits(params, cfg, hidden)
@@ -215,8 +251,9 @@ class LLMEngine:
         inflight = self._inflight
         if inflight is None:
             batch = self.scheduler.schedule()
+            drained = self._drain_terminally_finished()
             if batch is None:
-                return []
+                return drained
             self.step_count += 1
             self._key, step_key = jax.random.split(self._key)
             float_b = jnp.asarray(
@@ -229,10 +266,11 @@ class LLMEngine:
                     [batch.logits_indices, batch.top_k], axis=1))
                 next_tokens, self.kv_cache = self._prefill_fn(
                     self.params, self.kv_cache, int_t, int_b, float_b, step_key)
-                return self._process_window(
+                return drained + self._process_window(
                     batch, np.asarray(next_tokens)[:, None], set(), defer=False)
             inflight = self._dispatch_window(
                 batch, jnp.asarray(batch.tokens), batch.positions, float_b)
+            inflight["drained"] = drained
 
         successor = None
         if not self.scheduler.waiting and not inflight["zombies"]:
@@ -240,9 +278,9 @@ class LLMEngine:
 
         toks = np.asarray(inflight["dev_out"])   # syncs; overlaps successor
         self._inflight = successor
-        outputs = self._process_window(inflight["batch"], toks,
-                                       inflight["zombies"],
-                                       defer=successor is not None)
+        outputs = inflight.pop("drained", []) + self._process_window(
+            inflight["batch"], toks, inflight["zombies"],
+            defer=successor is not None)
         if successor is not None:
             successor["zombies"].update(
                 s.request_id for s in inflight["batch"].seqs if s.is_finished)
@@ -324,6 +362,23 @@ class LLMEngine:
                 finish_reason=seq.finish_reason.value if seq.finish_reason else None,
                 new_token_ids=new_tokens))
         return outputs
+
+    def _drain_terminally_finished(self) -> list[RequestOutput]:
+        """Sequences the scheduler finished on its own (grown past pool
+        capacity, no forward step possible) still owe the client a finished
+        RequestOutput — without this, generate()/a server handler waits on a
+        request that will never emit again."""
+        outs = []
+        for seq in self.scheduler.terminally_finished:
+            outs.append(RequestOutput(
+                request_id=seq.request_id,
+                prompt_token_ids=seq.prompt_token_ids,
+                output_token_ids=list(seq.output_token_ids),
+                finished=True,
+                finish_reason=seq.finish_reason.value if seq.finish_reason else None,
+                new_token_ids=[]))
+        self.scheduler.terminally_finished.clear()
+        return outs
 
     def _drain_deferred(self) -> None:
         for seq in self._deferred_release:

@@ -79,6 +79,10 @@ class Scheduler:
         self.allocator = PageAllocator(num_pages, self.page_size)
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
+        # Sequences terminated by the scheduler itself (grown past pool
+        # capacity) — the engine drains these into RequestOutputs so a client
+        # waiting on the request still sees a finished event.
+        self.terminally_finished: list[Sequence] = []
         # Monotone high-water marks for padded shapes (stats/debug).
         self.num_preemptions = 0
 
@@ -181,6 +185,7 @@ class Scheduler:
                     self.waiting.popleft()
                     seq.status = SequenceStatus.FINISHED
                     seq.finish_reason = FinishReason.LENGTH
+                    self.terminally_finished.append(seq)
                     logger.warning(
                         "%s needs %d pages > pool capacity %d; finishing at "
                         "length %d", seq.request_id, need,

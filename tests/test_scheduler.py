@@ -76,6 +76,25 @@ class TestAdmission:
         assert seq.status == SequenceStatus.FINISHED
         assert seq.finish_reason == FinishReason.LENGTH
         assert not sched.has_work()
+        # The engine must be able to surface a finished event for it (review
+        # finding: generate() raised KeyError / server clients hung).
+        assert sched.terminally_finished == [seq]
+
+    def test_engine_emits_output_for_capacity_terminated_seq(self):
+        """End-to-end: a scheduler-terminated sequence still produces a
+        finished RequestOutput through LLMEngine.step()."""
+        from kubernetes_gpu_cluster_tpu.engine import LLMEngine
+
+        cfg = _cfg(num_pages=3, page_size=4)   # 2 usable pages = 8 tokens
+        eng = LLMEngine(cfg)
+        seq = _seq("grown", 6)
+        eng.scheduler.add(seq)
+        for t in (7, 8, 9):                    # grown past 8-token capacity
+            seq.append_token(t)
+        outs = eng.step()
+        assert [o.request_id for o in outs] == ["grown"]
+        assert outs[0].finished and outs[0].finish_reason == "length"
+        assert not eng.has_unfinished_requests()
 
 
 class TestAbort:
