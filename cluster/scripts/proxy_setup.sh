@@ -1,0 +1,126 @@
+#!/usr/bin/env bash
+# proxy_setup.sh — egress/artifact-access layer for restricted networks.
+#
+# Generalizes the reference's L0 proxy stack (SURVEY §1 L0): Xray VLESS
+# client -> SOCKS5 :1080 (reference xray_setup.sh:18,50-91), or a persistent
+# ssh -N -D dynamic tunnel as a systemd unit (reference ssh-tunel.md:42-83),
+# bridged to HTTP by privoxy on :8118 (reference privoxy_setup.sh:20-21).
+# Every upper layer consumes one env var, HTTP_PROXY_URL=http://127.0.0.1:8118.
+#
+# Modes:
+#   --mode=ssh   SSH dynamic tunnel (needs TUNNEL_* env or /etc/kgct/tunnel.env)
+#   --mode=none  write registry-mirror config only (default: air-gapped TPU
+#                clusters usually mirror images instead of proxying)
+#   --mode=privoxy-only  bridge an existing SOCKS5 at $SOCKS5_PORT to :8118
+#
+# Self-test at the end mirrors the reference's curl check
+# (reference privoxy_setup.sh:32-38, README.md:28-31).
+set -euo pipefail
+
+MODE="none"
+SOCKS5_PORT="${SOCKS5_PORT:-1111}"
+HTTP_PORT="${HTTP_PORT:-8118}"
+ENV_FILE="${ENV_FILE:-/etc/kgct/tunnel.env}"
+REGISTRY_MIRROR="${REGISTRY_MIRROR:-}"
+DRY_RUN="${DRY_RUN:-0}"
+
+log() { echo -e "\e[32m[proxy]\e[0m $*"; }
+err() { echo -e "\e[31m[proxy]\e[0m $*" >&2; }
+run() { if [[ "$DRY_RUN" == "1" ]]; then echo "DRY: $*"; else "$@"; fi }
+
+for arg in "$@"; do
+  case "$arg" in
+    --mode=*) MODE="${arg#*=}" ;;
+    *) err "unknown flag $arg"; exit 1 ;;
+  esac
+done
+
+setup_ssh_tunnel() {
+  # .env-driven persistent SOCKS5 tunnel as a systemd unit with
+  # Restart=always (reference ssh-tunel.md:17-26,57-74)
+  if [[ "$DRY_RUN" != "1" ]]; then
+    # shellcheck disable=SC1090
+    [[ -f "$ENV_FILE" ]] && source "$ENV_FILE"
+    : "${TUNNEL_HOST:?set TUNNEL_HOST in $ENV_FILE}"
+    : "${TUNNEL_USER:?set TUNNEL_USER in $ENV_FILE}"
+    : "${TUNNEL_PORT:=22}"
+  fi
+  log "installing kgct-tunnel.service (SOCKS5 :$SOCKS5_PORT via ${TUNNEL_HOST:-\$TUNNEL_HOST})"
+  [[ "$DRY_RUN" == "1" ]] && { echo "DRY: write kgct-tunnel unit"; return; }
+  cat > /etc/systemd/system/kgct-tunnel.service <<EOF
+[Unit]
+Description=kgct persistent SOCKS5 ssh tunnel
+After=network-online.target
+Wants=network-online.target
+
+[Service]
+EnvironmentFile=$ENV_FILE
+ExecStart=/usr/bin/ssh -N -D ${SOCKS5_PORT} \\
+  -o ServerAliveInterval=30 -o ServerAliveCountMax=3 \\
+  -o ExitOnForwardFailure=yes -o StrictHostKeyChecking=accept-new \\
+  -p \${TUNNEL_PORT} \${TUNNEL_USER}@\${TUNNEL_HOST}
+Restart=always
+RestartSec=5
+
+[Install]
+WantedBy=multi-user.target
+EOF
+  systemctl daemon-reload
+  systemctl enable --now kgct-tunnel.service
+}
+
+setup_privoxy() {
+  # HTTP :8118 -> SOCKS5 bridge (reference privoxy_setup.sh:13-21: config is
+  # backed up, then forward-socks5 line swapped in)
+  log "installing privoxy bridge :$HTTP_PORT -> socks5 127.0.0.1:$SOCKS5_PORT"
+  [[ "$DRY_RUN" == "1" ]] && { echo "DRY: apt install privoxy + config"; return; }
+  apt-get install -y privoxy
+  local cfg=/etc/privoxy/config
+  cp -n "$cfg" "$cfg.kgct.bak" || true
+  sed -i -E 's@^\s*forward-socks5.*@@' "$cfg"
+  echo "forward-socks5 / 127.0.0.1:$SOCKS5_PORT ." >> "$cfg"
+  sed -i -E "s@^listen-address\s.*@listen-address 127.0.0.1:$HTTP_PORT@" "$cfg"
+  systemctl restart privoxy
+}
+
+setup_registry_mirror() {
+  # The TPU-era generalization: air-gapped clusters pull through a mirror
+  # instead of a proxy (SURVEY §1 L0 "TPU translation").
+  [[ -z "$REGISTRY_MIRROR" ]] && { log "no REGISTRY_MIRROR set; skipping"; return; }
+  log "configuring containerd registry mirror -> $REGISTRY_MIRROR"
+  [[ "$DRY_RUN" == "1" ]] && { echo "DRY: write hosts.toml"; return; }
+  for reg in docker.io registry.k8s.io ghcr.io; do
+    mkdir -p "/etc/containerd/certs.d/$reg"
+    cat > "/etc/containerd/certs.d/$reg/hosts.toml" <<EOF
+server = "https://$reg"
+
+[host."$REGISTRY_MIRROR"]
+  capabilities = ["pull", "resolve"]
+EOF
+  done
+  systemctl restart containerd 2>/dev/null || true
+}
+
+self_test() {  # reference privoxy_setup.sh:32-38
+  [[ "$MODE" == "none" || "$DRY_RUN" == "1" ]] && return 0
+  log "self-test via http://127.0.0.1:$HTTP_PORT"
+  if curl -fsS --max-time 20 --proxy "http://127.0.0.1:$HTTP_PORT" \
+       https://ipinfo.io/ip >/dev/null; then
+    log "proxy egress OK"
+  else
+    err "proxy self-test FAILED"; exit 1
+  fi
+}
+
+main() {
+  case "$MODE" in
+    ssh) setup_ssh_tunnel; setup_privoxy ;;
+    privoxy-only) setup_privoxy ;;
+    none) ;;
+    *) err "unknown --mode=$MODE (ssh|privoxy-only|none)"; exit 1 ;;
+  esac
+  setup_registry_mirror
+  self_test
+  log "done. export HTTP_PROXY_URL=http://127.0.0.1:$HTTP_PORT for the other scripts"
+}
+main

@@ -1,0 +1,99 @@
+#!/usr/bin/env bash
+# runtime_setup.sh — container runtime install for TPU VM nodes.
+#
+# Role of the reference's crio_setup.sh (pinned CRI-O v1.33 + crictl +
+# proxy drop-in, reference crio_setup.sh:1-70). TPU VM images ship containerd;
+# this script installs/pins it where absent, installs crictl for CRI
+# debugging, and wires the proxy drop-in. CRI-O remains selectable for parity
+# (--runtime=crio) since the engine layer is runtime-agnostic via CRI_SOCKET.
+#
+# Usage: sudo bash runtime_setup.sh [--runtime=containerd|crio]
+#        DRY_RUN=1 bash runtime_setup.sh
+set -euo pipefail
+
+RUNTIME="${RUNTIME:-containerd}"
+CRICTL_VERSION="${CRICTL_VERSION:-v1.33.0}"   # pinned (reference crio_setup.sh:46)
+CRIO_VERSION="${CRIO_VERSION:-v1.33}"         # pinned (reference crio_setup.sh:5-6)
+HTTP_PROXY_URL="${HTTP_PROXY_URL:-}"
+DRY_RUN="${DRY_RUN:-0}"
+
+log()  { echo -e "\e[32m[runtime]\e[0m $*"; }
+err()  { echo -e "\e[31m[runtime]\e[0m $*" >&2; }
+run()  { if [[ "$DRY_RUN" == "1" ]]; then echo "DRY: $*"; else "$@"; fi }
+
+for arg in "$@"; do
+  case "$arg" in
+    --runtime=*) RUNTIME="${arg#*=}" ;;
+    *) err "unknown flag $arg"; exit 1 ;;
+  esac
+done
+
+apt_proxied() {  # apt through the egress proxy (reference crio_setup.sh:27-31)
+  if [[ -n "$HTTP_PROXY_URL" ]]; then
+    run apt-get -o "Acquire::http::Proxy=$HTTP_PROXY_URL" \
+                -o "Acquire::https::Proxy=$HTTP_PROXY_URL" "$@"
+  else
+    run apt-get "$@"
+  fi
+}
+
+install_containerd() {
+  if command -v containerd >/dev/null; then
+    log "containerd already present: $(containerd --version 2>/dev/null || true)"
+  else
+    log "installing containerd"
+    apt_proxied update
+    apt_proxied install -y containerd
+  fi
+  run systemctl enable --now containerd
+}
+
+install_crio() {  # parity path (reference crio_setup.sh:19-41)
+  log "installing CRI-O $CRIO_VERSION"
+  if [[ "$DRY_RUN" == "1" ]]; then echo "DRY: add opensuse repo + install cri-o"; return; fi
+  local keyring=/etc/apt/keyrings/cri-o-apt-keyring.gpg
+  mkdir -p /etc/apt/keyrings
+  local curl_cmd=(curl -fsSL)
+  [[ -n "$HTTP_PROXY_URL" ]] && curl_cmd+=(--proxy "$HTTP_PROXY_URL")
+  "${curl_cmd[@]}" \
+    "https://download.opensuse.org/repositories/isv:/cri-o:/stable:/$CRIO_VERSION/deb/Release.key" \
+    | gpg --dearmor -o "$keyring"
+  echo "deb [signed-by=$keyring] https://download.opensuse.org/repositories/isv:/cri-o:/stable:/$CRIO_VERSION/deb/ /" \
+    > /etc/apt/sources.list.d/cri-o.list
+  apt_proxied update
+  apt_proxied install -y cri-o
+  systemctl enable --now crio
+}
+
+install_crictl() {  # CRI debugging CLI (reference crio_setup.sh:46-54)
+  command -v crictl >/dev/null && { log "crictl present"; return; }
+  log "installing crictl $CRICTL_VERSION"
+  if [[ "$DRY_RUN" == "1" ]]; then echo "DRY: download crictl"; return; fi
+  local url="https://github.com/kubernetes-sigs/cri-tools/releases/download/$CRICTL_VERSION/crictl-$CRICTL_VERSION-linux-amd64.tar.gz"
+  local curl_cmd=(curl -fsSL)
+  [[ -n "$HTTP_PROXY_URL" ]] && curl_cmd+=(--proxy "$HTTP_PROXY_URL")
+  "${curl_cmd[@]}" "$url" | tar -C /usr/local/bin -xz crictl
+  local sock="unix:///run/containerd/containerd.sock"
+  [[ "$RUNTIME" == "crio" ]] && sock="unix:///var/run/crio/crio.sock"
+  cat > /etc/crictl.yaml <<EOF
+runtime-endpoint: $sock
+image-endpoint: $sock
+EOF
+}
+
+verify() {  # smoke checks (reference crio_setup.sh:69-70, README.md:49)
+  log "verify:"
+  run systemctl is-active "$RUNTIME" || true
+  command -v crictl >/dev/null && run crictl --version || true
+}
+
+main() {
+  case "$RUNTIME" in
+    containerd) install_containerd ;;
+    crio) install_crio ;;
+    *) err "unknown --runtime=$RUNTIME"; exit 1 ;;
+  esac
+  install_crictl
+  verify
+}
+main

@@ -1,0 +1,294 @@
+#!/usr/bin/env bash
+# tpu_node_setup.sh — reset-first Kubernetes bootstrap for TPU VM nodes.
+#
+# TPU-native equivalent of the reference's k8s_setup.sh (the "big one",
+# reference k8s_setup.sh:1-432): every invocation FIRST tears down any prior
+# kubernetes state, then converges the node to a clean control-plane or worker
+# join — the reset-then-converge recovery property the reference's users
+# relied on (reference k8s_setup.sh:375-424, SURVEY §7 hard part (e)).
+#
+# Differences from the reference, by design (TPU VMs, not bare GPU metal):
+#   - containerd (stock on TPU VM images) instead of CRI-O; the CRI socket is
+#     a flag, both run (reference pinned CRI-O, crio_setup.sh:19-41).
+#   - no NVIDIA toolkit/CDI chain: TPU chips appear as /dev/vfio or /dev/accel
+#     devices handled by the kgct device plugin DaemonSet (cluster/device-plugin),
+#     replacing gpu-crio-setup.sh:87-126.
+#   - ICI topology node labels are applied at join time so the scheduler can
+#     pack TP groups onto one slice (replaces gpu=true labeling,
+#     reference values-01-minimal-example2.yaml:19-20 / README.md:90).
+#
+# Usage:
+#   sudo bash tpu_node_setup.sh --yes --role=control_plane
+#   sudo bash tpu_node_setup.sh --yes --role=node \
+#       --join="$(ssh cp 'kubeadm token create --print-join-command')"
+#   DRY_RUN=1 bash tpu_node_setup.sh --role=control_plane   # print, don't do
+set -euo pipefail
+
+# ---------------------------------------------------------------------------
+# config + flags (reference parse_args k8s_setup.sh:22-47)
+# ---------------------------------------------------------------------------
+KUBE_VERSION="${KUBE_VERSION:-v1.33}"
+CRI_SOCKET="${CRI_SOCKET:-unix:///run/containerd/containerd.sock}"
+POD_CIDR="${POD_CIDR:-10.244.0.0/16}"
+HTTP_PROXY_URL="${HTTP_PROXY_URL:-}"     # optional egress proxy (proxy_setup.sh)
+ROLE=""
+JOIN_CMD=""
+ASSUME_YES=0
+DRY_RUN="${DRY_RUN:-0}"
+
+log()  { echo -e "\e[32m[tpu-setup]\e[0m $*"; }
+warn() { echo -e "\e[33m[tpu-setup]\e[0m $*" >&2; }
+err()  { echo -e "\e[31m[tpu-setup]\e[0m $*" >&2; }
+
+run() {  # every state-changing command goes through run() => DRY_RUN-able
+  if [[ "$DRY_RUN" == "1" ]]; then echo "DRY: $*"; else "$@"; fi
+}
+
+usage() {
+  grep '^#' "$0" | head -30; exit 1
+}
+
+for arg in "$@"; do
+  case "$arg" in
+    --yes) ASSUME_YES=1 ;;
+    --role=*) ROLE="${arg#*=}" ;;
+    --join=*) JOIN_CMD="${arg#*=}" ;;
+    --kube-version=*) KUBE_VERSION="${arg#*=}" ;;
+    --cri-socket=*) CRI_SOCKET="${arg#*=}" ;;
+    --help|-h) usage ;;
+    *) err "unknown flag: $arg"; usage ;;
+  esac
+done
+
+require_root() {  # reference k8s_setup.sh:53-57
+  if [[ "$DRY_RUN" != "1" && "$(id -u)" -ne 0 ]]; then
+    err "must run as root (or DRY_RUN=1)"; exit 1
+  fi
+}
+
+confirm() {  # destructive-op gate (reference k8s_setup.sh:59-63)
+  [[ "$ASSUME_YES" == "1" || "$DRY_RUN" == "1" ]] && return 0
+  read -r -p "$1 [y/N] " ans
+  [[ "$ans" == "y" || "$ans" == "Y" ]]
+}
+
+# ---------------------------------------------------------------------------
+# phase 1: teardown (reference k8s_setup.sh:375-392, :67-163)
+# ---------------------------------------------------------------------------
+teardown() {
+  log "reset-first teardown"
+  run systemctl stop kubelet 2>/dev/null || true
+  run systemctl disable kubelet 2>/dev/null || true
+  # kill any stray apiserver and free 6443 (reference :136-163)
+  run pkill -9 -f kube-apiserver 2>/dev/null || true
+  if command -v ss >/dev/null; then
+    local pids
+    pids=$(ss -lptn 'sport = :6443' 2>/dev/null \
+           | grep -oP 'pid=\K[0-9]+' | sort -u || true)
+    for p in $pids; do run kill -9 "$p" || true; done
+  fi
+  if confirm "remove /etc/kubernetes /var/lib/kubelet /var/lib/etcd ~/.kube?"; then
+    run rm -rf /etc/kubernetes /var/lib/kubelet /var/lib/etcd \
+        "${SUDO_USER:+/home/$SUDO_USER/.kube}" /root/.kube
+  fi
+  run kubeadm reset -f 2>/dev/null || true
+}
+
+# ---------------------------------------------------------------------------
+# phase 2: host prereqs — swap + kernel networking
+# (reference k8s_setup.sh:165-261; TPU VMs usually ship swapless, still gated)
+# ---------------------------------------------------------------------------
+disable_swap() {
+  log "disabling swap (runtime + units + fstab)"
+  run swapoff -a || true
+  # mask systemd swap units (reference :218-231)
+  for unit in $(systemctl list-unit-files --type swap --no-legend 2>/dev/null \
+                | awk '{print $1}'); do
+    run systemctl mask "$unit" || true
+  done
+  # comment swap lines out of fstab with a timestamped backup (reference :187-216)
+  if [[ -f /etc/fstab ]] && grep -qE '^[^#].*\sswap\s' /etc/fstab; then
+    local backup="/etc/fstab.kgct-$(date +%s).bak"
+    run cp /etc/fstab "$backup"
+    run sed -i -E 's@^([^#].*\sswap\s.*)@#\1@' /etc/fstab
+    log "fstab swap entries commented (backup: $backup)"
+  fi
+}
+
+setup_netfilter() {
+  log "kernel networking prereqs (br_netfilter, forwarding)"
+  run modprobe br_netfilter || true
+  run modprobe overlay || true
+  if [[ "$DRY_RUN" == "1" ]]; then
+    echo "DRY: write /etc/modules-load.d/kgct-k8s.conf + sysctl"
+    return
+  fi
+  cat > /etc/modules-load.d/kgct-k8s.conf <<EOF
+br_netfilter
+overlay
+EOF
+  cat > /etc/sysctl.d/99-kgct-k8s.conf <<EOF
+net.bridge.bridge-nf-call-iptables  = 1
+net.bridge.bridge-nf-call-ip6tables = 1
+net.ipv4.ip_forward                 = 1
+EOF
+  sysctl --system >/dev/null
+}
+
+# ---------------------------------------------------------------------------
+# phase 3: container runtime wiring (reference crio_setup.sh + k8s_setup.sh:291-316)
+# ---------------------------------------------------------------------------
+setup_runtime() {
+  log "container runtime: containerd (systemd cgroups, proxy drop-in)"
+  if ! command -v containerd >/dev/null && [[ "$DRY_RUN" != "1" ]]; then
+    err "containerd not installed; run runtime_setup.sh first"; exit 1
+  fi
+  if [[ "$DRY_RUN" == "1" ]]; then echo "DRY: configure containerd"; return; fi
+  mkdir -p /etc/containerd
+  if ! containerd config dump 2>/dev/null | grep -q "SystemdCgroup = true"; then
+    containerd config default \
+      | sed 's/SystemdCgroup = false/SystemdCgroup = true/' \
+      > /etc/containerd/config.toml
+  fi
+  # proxy drop-in so IMAGE PULLS traverse the egress proxy with cluster CIDRs
+  # excluded — the hard-won NO_PROXY fix (reference k8s_setup.sh:291-316,
+  # journaled old_README.md:659-684)
+  if [[ -n "$HTTP_PROXY_URL" ]]; then
+    mkdir -p /etc/systemd/system/containerd.service.d
+    cat > /etc/systemd/system/containerd.service.d/http-proxy.conf <<EOF
+[Service]
+Environment="HTTP_PROXY=$HTTP_PROXY_URL"
+Environment="HTTPS_PROXY=$HTTP_PROXY_URL"
+Environment="NO_PROXY=localhost,127.0.0.1,10.0.0.0/8,$POD_CIDR,.svc,.cluster.local"
+EOF
+  fi
+  systemctl daemon-reload
+  systemctl enable --now containerd
+  systemctl restart containerd
+}
+
+# ---------------------------------------------------------------------------
+# phase 4: kubeadm/kubelet/kubectl install, pinned + held
+# (reference install_k8s_apt k8s_setup.sh:263-289)
+# ---------------------------------------------------------------------------
+install_k8s() {
+  log "installing kubeadm/kubelet/kubectl $KUBE_VERSION (pinned, apt-held)"
+  if [[ "$DRY_RUN" == "1" ]]; then echo "DRY: apt install kube* $KUBE_VERSION"; return; fi
+  command -v kubeadm >/dev/null && { log "kubeadm present, skipping"; return; }
+  local keyring=/etc/apt/keyrings/kubernetes-apt-keyring.gpg
+  mkdir -p /etc/apt/keyrings
+  local curl_cmd=(curl -fsSL)
+  [[ -n "$HTTP_PROXY_URL" ]] && curl_cmd+=(--proxy "$HTTP_PROXY_URL")
+  "${curl_cmd[@]}" "https://pkgs.k8s.io/core:/stable:/$KUBE_VERSION/deb/Release.key" \
+    | gpg --dearmor -o "$keyring"
+  echo "deb [signed-by=$keyring] https://pkgs.k8s.io/core:/stable:/$KUBE_VERSION/deb/ /" \
+    > /etc/apt/sources.list.d/kubernetes.list
+  apt-get update
+  apt-get install -y kubelet kubeadm kubectl
+  apt-mark hold kubelet kubeadm kubectl
+  systemctl enable kubelet
+}
+
+# ---------------------------------------------------------------------------
+# phase 5: TPU enablement — detect chips, stage topology labels
+# (replaces the reference's NVIDIA chain gpu-crio-setup.sh:58-126; the device
+#  plugin DaemonSet advertises google.com/tpu, cluster/device-plugin/)
+# ---------------------------------------------------------------------------
+detect_tpu() {
+  log "detecting TPU devices"
+  local chips=0 topo="none" accel_type="none"
+  if compgen -G "/dev/accel*" >/dev/null; then
+    chips=$(ls /dev/accel* | wc -l)
+  elif compgen -G "/dev/vfio/*" >/dev/null; then
+    chips=$(ls /dev/vfio/ | grep -vc vfio || true)
+  fi
+  # TPU VM metadata (best effort; absent off-GCE)
+  if command -v curl >/dev/null; then
+    accel_type=$(curl -fs -H "Metadata-Flavor: Google" \
+      "http://metadata.google.internal/computeMetadata/v1/instance/attributes/accelerator-type" \
+      2>/dev/null || echo none)
+    topo=$(curl -fs -H "Metadata-Flavor: Google" \
+      "http://metadata.google.internal/computeMetadata/v1/instance/attributes/tpu-topology" \
+      2>/dev/null || echo none)
+  fi
+  TPU_CHIPS="$chips"; TPU_TOPOLOGY="$topo"; TPU_ACCEL_TYPE="$accel_type"
+  log "TPU: chips=$TPU_CHIPS type=$TPU_ACCEL_TYPE topology=$TPU_TOPOLOGY"
+}
+
+label_node() {  # ICI-topology labels for slice-packing scheduling
+  local node="$1"
+  [[ "$TPU_CHIPS" == "0" ]] && { warn "no TPU chips; skipping labels"; return; }
+  run kubectl label node "$node" --overwrite \
+    "kgct.io/tpu=true" \
+    "kgct.io/tpu-chips=$TPU_CHIPS" \
+    "kgct.io/tpu-topology=$TPU_TOPOLOGY" \
+    "kgct.io/accelerator-type=$TPU_ACCEL_TYPE"
+}
+
+# ---------------------------------------------------------------------------
+# phase 6: init / join (reference init_control_plane k8s_setup.sh:336-361,
+#                       join_node :363-372)
+# ---------------------------------------------------------------------------
+init_control_plane() {
+  log "kubeadm init (control plane)"
+  local logf="/var/log/kgct-kubeadm-init-$(date +%s).log"
+  local extra=()
+  [[ -n "${CONTROL_PLANE_ENDPOINT:-}" ]] && \
+    extra+=(--control-plane-endpoint "$CONTROL_PLANE_ENDPOINT" --upload-certs)
+  run kubeadm init \
+    --cri-socket="$CRI_SOCKET" \
+    --pod-network-cidr="$POD_CIDR" \
+    "${extra[@]}" 2>&1 | tee "$logf"
+  # success heuristic: the join hint must be in the log (reference :354-359)
+  if [[ "$DRY_RUN" != "1" ]] && ! grep -q 'kubeadm join .* --token' "$logf"; then
+    err "kubeadm init did not produce a join command — see $logf"; exit 1
+  fi
+  post_init_kubeconfig
+  if [[ "$DRY_RUN" != "1" ]]; then
+    detect_tpu
+    label_node "$(hostname | tr '[:upper:]' '[:lower:]')" || true
+  fi
+  log "control plane up. Next:"
+  log "  kubectl apply -f <CNI manifest>   # e.g. flannel/calico for $POD_CIDR"
+  log "  kubectl apply -f cluster/device-plugin/manifest/daemonset.yaml"
+}
+
+post_init_kubeconfig() {  # reference k8s_setup.sh:320-334
+  [[ "$DRY_RUN" == "1" ]] && { echo "DRY: install kubeconfig"; return; }
+  local target_user="${SUDO_USER:-root}"
+  local home_dir; home_dir=$(eval echo "~$target_user")
+  mkdir -p "$home_dir/.kube"
+  cp -f /etc/kubernetes/admin.conf "$home_dir/.kube/config"
+  chown "$(id -u "$target_user")":"$(id -g "$target_user")" "$home_dir/.kube/config"
+}
+
+join_node() {
+  [[ -z "$JOIN_CMD" ]] && { err "--role=node requires --join=..."; exit 1; }
+  # auto-append the CRI socket (reference k8s_setup.sh:41-44)
+  [[ "$JOIN_CMD" != *"--cri-socket"* ]] && JOIN_CMD="$JOIN_CMD --cri-socket=$CRI_SOCKET"
+  log "joining cluster"
+  run bash -c "$JOIN_CMD"
+  detect_tpu
+  log "joined. Label from the control plane:"
+  log "  kubectl label node $(hostname) kgct.io/tpu=true kgct.io/tpu-chips=$TPU_CHIPS kgct.io/tpu-topology=$TPU_TOPOLOGY"
+}
+
+# ---------------------------------------------------------------------------
+# main (reference main() k8s_setup.sh:375-424: teardown ALWAYS runs; the role
+# only gates the final step)
+# ---------------------------------------------------------------------------
+main() {
+  require_root
+  teardown
+  disable_swap
+  setup_netfilter
+  setup_runtime
+  install_k8s
+  case "$ROLE" in
+    control_plane) init_control_plane ;;
+    node) join_node ;;
+    "") log "no --role given: node reset + prereqs done (re-runnable)" ;;
+    *) err "unknown --role=$ROLE (control_plane|node)"; exit 1 ;;
+  esac
+}
+main

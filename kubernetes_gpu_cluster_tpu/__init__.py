@@ -19,10 +19,11 @@ Subpackages:
     ops       — Pallas TPU kernels + XLA fallbacks (paged attention, ragged prefill)
     engine    — paged KV cache, continuous-batching scheduler, LLMEngine
     parallel  — mesh/sharding, TP/PP/EP/DP over ICI & DCN, jax.distributed bootstrap
-    serving   — OpenAI-compatible API server, router, metrics
-    train     — sharded training/fine-tuning step (dp/tp/pp)
-    cluster   — ops layer: bootstrap scripts, TPU device plugin, chart renderer, HA
-    utils     — logging, tracing, math helpers
+    serving   — OpenAI-compatible API server, router, tokenizer, metrics
+    utils     — logging, math helpers
+
+The ops layer (bootstrap scripts, TPU device plugin, deployment chart, HA)
+lives in the repo-root ``cluster/`` directory, not as a Python subpackage.
 """
 
 __version__ = "0.1.0"

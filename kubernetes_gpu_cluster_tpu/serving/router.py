@@ -1,0 +1,188 @@
+"""Request router: one front door over N data-parallel engine replicas.
+
+The reference exposed its replicas behind ``vllm-router-service`` and
+operators port-forwarded to it (``old_README.md:1174-1176, 1472-1476``);
+replicas were plain Deployment pods spread by anti-affinity
+(``values-01-minimal-example2.yaml:10, 23-49``). This router is the native
+equivalent: an aiohttp reverse proxy that
+
+- tracks replica health (periodic GET /health; unhealthy replicas leave the
+  rotation and return on recovery — the k8s-native restart/rollout story of
+  SURVEY §5.3 at the traffic layer),
+- balances by least-outstanding-requests (better than round-robin under
+  continuous batching: a replica stuck on long generations accumulates
+  in-flight count and sheds new work),
+- streams responses through unbuffered (SSE passthrough).
+
+In-cluster, replica discovery is the headless-Service DNS name; static URLs
+work for local/dev. Deployment manifests are rendered by cluster/chart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from ..utils import get_logger
+
+logger = get_logger("serving.router")
+
+HOP_HEADERS = {"transfer-encoding", "content-length", "connection",
+               "keep-alive", "host"}
+
+
+class Replica:
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.healthy = True
+        self.inflight = 0
+        self.consecutive_failures = 0
+
+
+class Router:
+    def __init__(self, replica_urls: list[str],
+                 health_interval_s: float = 5.0,
+                 fail_threshold: int = 2):
+        self.replicas = [Replica(u) for u in replica_urls]
+        self.health_interval_s = health_interval_s
+        self.fail_threshold = fail_threshold
+        self._rr = itertools.count()
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._health_task: Optional[asyncio.Task] = None
+
+    # -- app wiring ----------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/v1/models", self.proxy)
+        app.router.add_post("/v1/completions", self.proxy)
+        app.router.add_post("/v1/chat/completions", self.proxy)
+        app.router.add_get("/metrics", self.metrics)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app: web.Application) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10))
+        self._health_task = asyncio.create_task(self._health_loop())
+
+    async def _on_cleanup(self, app: web.Application) -> None:
+        if self._health_task:
+            self._health_task.cancel()
+        if self._session:
+            await self._session.close()
+
+    # -- health --------------------------------------------------------------
+
+    async def _health_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.health_interval_s)
+            await asyncio.gather(*(self._check(r) for r in self.replicas),
+                                 return_exceptions=True)
+
+    async def _check(self, replica: Replica) -> None:
+        try:
+            async with self._session.get(f"{replica.url}/health") as resp:
+                ok = resp.status == 200
+        except Exception:
+            ok = False
+        if ok:
+            replica.consecutive_failures = 0
+            if not replica.healthy:
+                logger.info("replica %s back in rotation", replica.url)
+            replica.healthy = True
+        else:
+            replica.consecutive_failures += 1
+            if (replica.healthy
+                    and replica.consecutive_failures >= self.fail_threshold):
+                logger.warning("replica %s marked unhealthy", replica.url)
+                replica.healthy = False
+
+    async def health(self, request: web.Request) -> web.Response:
+        healthy = [r.url for r in self.replicas if r.healthy]
+        status = 200 if healthy else 503
+        return web.json_response(
+            {"status": "ok" if healthy else "no healthy replicas",
+             "replicas": {r.url: {"healthy": r.healthy,
+                                  "inflight": r.inflight}
+                          for r in self.replicas}},
+            status=status)
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        lines = ["# TYPE kgct_router_replica_healthy gauge",
+                 "# TYPE kgct_router_replica_inflight gauge"]
+        for r in self.replicas:
+            lines.append(f'kgct_router_replica_healthy{{replica="{r.url}"}} '
+                         f"{int(r.healthy)}")
+            lines.append(f'kgct_router_replica_inflight{{replica="{r.url}"}} '
+                         f"{r.inflight}")
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+    # -- proxying ------------------------------------------------------------
+
+    def _pick(self) -> Optional[Replica]:
+        healthy = [r for r in self.replicas if r.healthy]
+        if not healthy:
+            return None
+        least = min(r.inflight for r in healthy)
+        tied = [r for r in healthy if r.inflight == least]
+        return tied[next(self._rr) % len(tied)]
+
+    async def proxy(self, request: web.Request) -> web.StreamResponse:
+        replica = self._pick()
+        if replica is None:
+            return web.json_response(
+                {"error": {"message": "no healthy replicas", "code": 503}},
+                status=503)
+        body = await request.read()
+        replica.inflight += 1
+        try:
+            async with self._session.request(
+                    request.method, f"{replica.url}{request.path}",
+                    data=body if body else None,
+                    headers={k: v for k, v in request.headers.items()
+                             if k.lower() not in HOP_HEADERS}) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in HOP_HEADERS:
+                        resp.headers[k] = v
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_any():
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        except aiohttp.ClientError as e:
+            replica.consecutive_failures += 1
+            if replica.consecutive_failures >= self.fail_threshold:
+                replica.healthy = False
+            return web.json_response(
+                {"error": {"message": f"upstream error: {e}", "code": 502}},
+                status=502)
+        finally:
+            replica.inflight -= 1
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """CLI: python -m kubernetes_gpu_cluster_tpu.serving.router
+    --replicas http://pod-0:8000,http://pod-1:8000 --port 8080"""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--replicas", required=True,
+                   help="comma-separated replica base URLs")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    args = p.parse_args(argv)
+    router = Router(args.replicas.split(","))
+    web.run_app(router.build_app(), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()
