@@ -95,22 +95,24 @@ def main():
           f"backend={jax.default_backend()}")
 
     # --- 1+2: decode substep (greedy-sample feedback keeps it on device) ----
+    # params flow through state as a jit ARGUMENT: closing over them would
+    # bake 2.2 GB of weights into the program as constants — each compile
+    # then re-uploads the model through the tunnel (minutes per measurement).
     def substep(use_pallas, stub=False):
-        @functools.partial(jax.jit, donate_argnums=0)
-        def f(state):
-            kvc, tokens = state
+        @functools.partial(jax.jit, donate_argnums=1)
+        def f(prms, kvc, tokens):
             real = attn.paged_decode_attention
             if stub:   # trace-time stub; restored right after tracing
                 attn.paged_decode_attention = lambda q, *a, **k: q
             try:
                 hidden, kvc, _ = model_lib.forward_decode(
-                    params, cfg, tokens, meta, kvc, use_pallas=use_pallas)
+                    prms, cfg, tokens, meta, kvc, use_pallas=use_pallas)
             finally:
                 attn.paged_decode_attention = real
-            logits = model_lib.compute_logits(params, cfg, hidden)
+            logits = model_lib.compute_logits(prms, cfg, hidden)
             return kvc, jnp.argmax(logits, -1).astype(jnp.int32)
 
-        return f
+        return lambda state: f(params, *state)   # params: argument, not donated
 
     print(f"substep XLA attn:      {timed_chain(substep(False), (mk_kv(), tokens0)):8.3f} ms")
     if jax.default_backend() == "tpu":
@@ -124,8 +126,7 @@ def main():
 
     def attn_loop(use_pallas):
         @jax.jit
-        def f(state):
-            q1, _ = state
+        def f(q1, k_pool, v_pool):
             def body(acc, xs):
                 kp, vp = xs
                 o = attn.paged_decode_attention(
@@ -133,9 +134,14 @@ def main():
                     hd ** -0.5, use_pallas=use_pallas)
                 return acc + o.astype(jnp.float32), None
             acc, _ = jax.lax.scan(body, jnp.zeros((B, nh, hd), jnp.float32),
-                                  (kv.k, kv.v))
-            return acc.astype(cfg.jnp_dtype), acc
-        return f
+                                  (k_pool, v_pool))
+            return acc.astype(cfg.jnp_dtype)
+        # pool passed as argument (a closed-over pool would be baked into the
+        # program as 0.5 GB of constants and re-uploaded at compile)
+        def step(state):
+            out = f(state[0], kv.k, kv.v)
+            return (out, None)
+        return step
 
     print(f"attn x{L} XLA:          {timed_chain(attn_loop(False), (q1, None)):8.3f} ms")
     if jax.default_backend() == "tpu":

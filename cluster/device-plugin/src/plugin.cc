@@ -1,0 +1,329 @@
+// kgct-tpu-device-plugin: a kubelet device plugin advertising TPU chips as
+// `google.com/tpu`, implemented against the kubelet device-plugin gRPC API
+// v1beta1 with the embedded gRPC/HTTP2/HPACK stack in this directory.
+//
+// Role in the framework: the TPU-native replacement for the NVIDIA device
+// plugin DaemonSet the reference applied and patched (reference
+// `README.md:90`, `old_README.md:1206-1318`, `gpu-crio-setup.sh:87-126`).
+// Where the GPU chain needed toolkit + CDI + OCI hooks to inject devices,
+// TPU VMs only need the /dev/accel* (or /dev/vfio/*) character devices
+// mapped into the container plus TPU_VISIBLE_CHIPS — both served from
+// Allocate() here, no runtime hooks required.
+//
+// Flow (v1beta1 contract):
+//   1. serve DevicePlugin on <plugin-dir>/kgct-tpu.sock
+//   2. dial <plugin-dir>/kubelet.sock, Registration/Register(endpoint,
+//      resource)
+//   3. kubelet connects back: ListAndWatch streams the device inventory
+//      (re-sent whenever health changes); Allocate returns device specs +
+//      envs per container
+//   4. if kubelet.sock is recreated (kubelet restart), re-register
+//
+// Tests: tests/test_device_plugin.py runs this binary against a fake kubelet
+// built on grpcio + the real protoc-generated v1beta1 messages, proving
+// wire-level interop of the whole embedded stack.
+
+#include <dirent.h>
+#include <signal.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "grpc.h"
+#include "pb.h"
+
+namespace kgct {
+namespace {
+
+struct Options {
+  std::string plugin_dir = "/var/lib/kubelet/device-plugins";
+  std::string endpoint = "kgct-tpu.sock";
+  std::string resource = "google.com/tpu";
+  std::string dev_root = "/dev";
+  std::string dev_prefix = "accel";
+  int health_interval_s = 5;
+  bool register_with_kubelet = true;
+  bool oneshot = false;  // tests: exit after first ListAndWatch push + idle
+};
+
+volatile sig_atomic_t g_stop = 0;
+void OnSignal(int) { g_stop = 1; }
+
+// -- v1beta1 message encode/decode (field numbers per the public
+// k8s.io/kubelet device-plugin api.proto) ----------------------------------
+
+std::string EncodeDevice(const std::string& id, const std::string& health) {
+  PbWriter w;
+  w.StringField(1, id);       // Device.ID
+  w.StringField(2, health);   // Device.health
+  return w.str();
+}
+
+std::string EncodeListAndWatchResponse(
+    const std::map<std::string, std::string>& devices) {
+  PbWriter w;
+  for (const auto& [id, health] : devices)
+    w.MessageField(1, EncodeDevice(id, health));
+  return w.str();
+}
+
+std::string EncodeRegisterRequest(const Options& opt) {
+  PbWriter options;
+  // pre_start_required=false, get_preferred_allocation_available=false:
+  // both default -> empty options submessage.
+  PbWriter w;
+  w.StringField(1, "v1beta1");       // version
+  w.StringField(2, opt.endpoint);    // endpoint (basename, kubelet joins dir)
+  w.StringField(3, opt.resource);    // resource_name
+  w.MessageField(4, options.str());  // options
+  return w.str();
+}
+
+std::string EncodeMount(const std::string& container_path,
+                        const std::string& host_path, bool read_only) {
+  PbWriter w;
+  w.StringField(1, container_path);
+  w.StringField(2, host_path);
+  w.BoolField(3, read_only);
+  return w.str();
+}
+
+std::string EncodeDeviceSpec(const std::string& container_path,
+                             const std::string& host_path,
+                             const std::string& permissions) {
+  PbWriter w;
+  w.StringField(1, container_path);
+  w.StringField(2, host_path);
+  w.StringField(3, permissions);
+  return w.str();
+}
+
+std::string EncodeEnvEntry(const std::string& k, const std::string& v) {
+  // map<string,string> entry: key=1, value=2.
+  PbWriter w;
+  w.StringField(1, k);
+  w.StringField(2, v);
+  return w.str();
+}
+
+// -- device discovery -------------------------------------------------------
+
+std::map<std::string, std::string> ScanDevices(const Options& opt) {
+  std::map<std::string, std::string> devices;  // id -> health
+  DIR* d = opendir(opt.dev_root.c_str());
+  if (d == nullptr) return devices;
+  while (dirent* e = readdir(d)) {
+    std::string name = e->d_name;
+    if (name.rfind(opt.dev_prefix, 0) != 0) continue;
+    std::string rest = name.substr(opt.dev_prefix.size());
+    if (rest.empty() ||
+        !std::all_of(rest.begin(), rest.end(), [](char c) {
+          return c >= '0' && c <= '9';
+        }))
+      continue;
+    struct stat st{};
+    std::string path = opt.dev_root + "/" + name;
+    bool healthy = stat(path.c_str(), &st) == 0;
+    devices[name] = healthy ? "Healthy" : "Unhealthy";
+  }
+  closedir(d);
+  return devices;
+}
+
+// -- plugin service ---------------------------------------------------------
+
+class Plugin {
+ public:
+  explicit Plugin(Options opt) : opt_(std::move(opt)) {
+    devices_ = ScanDevices(opt_);
+    server_.AddUnary(
+        "/v1beta1.DevicePlugin/GetDevicePluginOptions",
+        [](const std::string&) { return std::string(); });  // all defaults
+    server_.AddUnary("/v1beta1.DevicePlugin/Allocate",
+                     [this](const std::string& req) { return Allocate(req); });
+    server_.AddUnary("/v1beta1.DevicePlugin/PreStartContainer",
+                     [](const std::string&) { return std::string(); });
+    server_.AddUnary(
+        "/v1beta1.DevicePlugin/GetPreferredAllocation",
+        [](const std::string&) -> std::string {
+          throw GrpcError(kUnimplemented, "preferred allocation not offered");
+        });
+    server_.AddServerStream(
+        "/v1beta1.DevicePlugin/ListAndWatch",
+        [this](const std::string&, GrpcServer::StreamPtr s) {
+          server_.StreamSend(s, EncodeListAndWatchResponse(devices_));
+          watchers_.push_back(std::move(s));
+          pushed_once_ = true;
+        });
+  }
+
+  std::string SocketPath() const { return opt_.plugin_dir + "/" + opt_.endpoint; }
+  std::string KubeletSock() const { return opt_.plugin_dir + "/kubelet.sock"; }
+
+  bool Register() {
+    std::string resp, err;
+    int status = GrpcUnaryCall(KubeletSock(), "/v1beta1.Registration/Register",
+                               EncodeRegisterRequest(opt_), &resp, &err);
+    if (status != kOk) {
+      fprintf(stderr, "[kgct-device-plugin] register failed (%d): %s\n",
+              status, err.c_str());
+      return false;
+    }
+    fprintf(stderr,
+            "[kgct-device-plugin] registered %s with kubelet (%zu devices)\n",
+            opt_.resource.c_str(), devices_.size());
+    return true;
+  }
+
+  void Run() {
+    server_.Listen(SocketPath());
+    ino_t kubelet_ino = StatIno(KubeletSock());
+    if (opt_.register_with_kubelet) {
+      // Kubelet may not be up yet (DaemonSet races kubelet restarts): retry.
+      for (int i = 0; i < 60 && !Register() && !g_stop; ++i) sleep(2);
+    }
+    time_t last_scan = time(nullptr);
+    while (!g_stop) {
+      server_.PollOnce(500);
+      time_t now = time(nullptr);
+      if (now - last_scan >= opt_.health_interval_s) {
+        last_scan = now;
+        RescanAndNotify();
+        ino_t ino = StatIno(KubeletSock());
+        if (opt_.register_with_kubelet && ino != 0 && ino != kubelet_ino) {
+          fprintf(stderr,
+                  "[kgct-device-plugin] kubelet.sock changed, re-registering\n");
+          kubelet_ino = ino;
+          Register();
+        }
+      }
+      if (opt_.oneshot && pushed_once_ && NoLiveWatchers()) break;
+    }
+  }
+
+ private:
+  static ino_t StatIno(const std::string& path) {
+    struct stat st{};
+    return stat(path.c_str(), &st) == 0 ? st.st_ino : 0;
+  }
+
+  bool NoLiveWatchers() {
+    Prune();
+    return watchers_.empty();
+  }
+
+  void Prune() {
+    watchers_.erase(std::remove_if(watchers_.begin(), watchers_.end(),
+                                   [](const GrpcServer::StreamPtr& s) {
+                                     return !s || !s->alive;
+                                   }),
+                    watchers_.end());
+  }
+
+  void RescanAndNotify() {
+    auto fresh = ScanDevices(opt_);
+    if (fresh == devices_) return;
+    fprintf(stderr, "[kgct-device-plugin] device set changed: %zu devices\n",
+            fresh.size());
+    devices_ = std::move(fresh);
+    Prune();
+    std::string msg = EncodeListAndWatchResponse(devices_);
+    for (auto& s : watchers_) server_.StreamSend(s, msg);
+  }
+
+  std::string Allocate(const std::string& req) {
+    // AllocateRequest{ repeated ContainerAllocateRequest{repeated string=1} }
+    PbWriter resp;
+    PbReader r(req);
+    while (r.Next()) {
+      if (r.field() != 1) {
+        r.skip();
+        continue;
+      }
+      PbReader creq(r.bytes());
+      std::vector<std::string> ids;
+      while (creq.Next()) {
+        if (creq.field() == 1)
+          ids.emplace_back(creq.bytes());
+        else
+          creq.skip();
+      }
+      PbWriter cresp;
+      std::string chips;
+      for (const auto& id : ids) {
+        if (!devices_.count(id))
+          throw GrpcError(kNotFound, "unknown device " + id);
+        // container_path mirrors host_path: jax/libtpu discover chips by
+        // scanning /dev for the same names the host exposes.
+        cresp.MessageField(
+            3, EncodeDeviceSpec("/dev/" + id, opt_.dev_root + "/" + id, "rw"));
+        std::string idx = id.substr(opt_.dev_prefix.size());
+        chips += (chips.empty() ? "" : ",") + idx;
+      }
+      // libtpu chip selection (the TPU analogue of NVIDIA_VISIBLE_DEVICES).
+      cresp.MessageField(1, EncodeEnvEntry("TPU_VISIBLE_CHIPS", chips));
+      // vfio containers also need /dev/vfio when present on the host.
+      struct stat st{};
+      if (stat("/dev/vfio", &st) == 0)
+        cresp.MessageField(2, EncodeMount("/dev/vfio", "/dev/vfio", false));
+      resp.MessageField(1, cresp.str());
+    }
+    return resp.str();
+  }
+
+  Options opt_;
+  GrpcServer server_;
+  std::map<std::string, std::string> devices_;
+  std::vector<GrpcServer::StreamPtr> watchers_;
+  bool pushed_once_ = false;
+};
+
+int Main(int argc, char** argv) {
+  Options opt;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto val = [&](const char* flag) -> const char* {
+      size_t n = strlen(flag);
+      if (a.rfind(flag, 0) == 0 && a.size() > n && a[n] == '=')
+        return a.c_str() + n + 1;
+      return nullptr;
+    };
+    if (const char* v = val("--plugin-dir")) opt.plugin_dir = v;
+    else if (const char* v = val("--endpoint")) opt.endpoint = v;
+    else if (const char* v = val("--resource")) opt.resource = v;
+    else if (const char* v = val("--dev-root")) opt.dev_root = v;
+    else if (const char* v = val("--dev-prefix")) opt.dev_prefix = v;
+    else if (const char* v = val("--health-interval-s"))
+      opt.health_interval_s = atoi(v);
+    else if (a == "--no-register") opt.register_with_kubelet = false;
+    else if (a == "--oneshot") opt.oneshot = true;
+    else {
+      fprintf(stderr,
+              "usage: kgct-tpu-device-plugin [--plugin-dir=DIR] "
+              "[--endpoint=NAME.sock] [--resource=NAME] [--dev-root=DIR] "
+              "[--dev-prefix=accel] [--health-interval-s=N] [--no-register] "
+              "[--oneshot]\n");
+      return a == "--help" ? 0 : 2;
+    }
+  }
+  signal(SIGPIPE, SIG_IGN);
+  signal(SIGTERM, OnSignal);
+  signal(SIGINT, OnSignal);
+  Plugin plugin(std::move(opt));
+  plugin.Run();
+  fprintf(stderr, "[kgct-device-plugin] exiting\n");
+  return 0;
+}
+
+}  // namespace
+}  // namespace kgct
+
+int main(int argc, char** argv) { return kgct::Main(argc, argv); }

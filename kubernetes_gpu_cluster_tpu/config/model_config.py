@@ -30,6 +30,9 @@ class ModelConfig:
     num_kv_heads: int
     head_dim: int
     rope_theta: float = 10000.0
+    # HF ``rope_scaling`` (llama3 / linear), stored as a sorted (key, value)
+    # tuple so the frozen config stays hashable; see ops/rope.scaled_inv_freq.
+    rope_scaling: Optional[tuple] = None
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     # Qwen2/2.5 use bias on q/k/v projections (not o).
@@ -55,6 +58,10 @@ class ModelConfig:
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def rope_scaling_dict(self) -> Optional[dict]:
+        return dict(self.rope_scaling) if self.rope_scaling else None
 
     def replace(self, **kw) -> "ModelConfig":
         return dataclasses.replace(self, **kw)

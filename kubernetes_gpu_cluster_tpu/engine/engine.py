@@ -22,7 +22,6 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from functools import partial
 from typing import Optional
 
 import jax
@@ -146,9 +145,50 @@ class LLMEngine:
             logger.warning("Pallas kernels disabled under GSPMD mesh; "
                            "using XLA attention")
             return False
+        return self._probe_pallas_compile()
+
+    def _probe_pallas_compile(self) -> bool:
+        """Compile one tiny decode-kernel call ON THE REAL CHIP before
+        committing to the Pallas path. Mosaic layout constraints surface only
+        at jit-compile time (round-2 postmortem: the static lane check passed,
+        the kernel did not compile, and the engine had no fallback), so the
+        only reliable gate is an actual compile at this model's head geometry.
+        ~1s for the tiny shapes; cached for the process lifetime."""
+        from ..ops.pallas.paged_decode import pallas_paged_decode
+
+        cfg = self.model_config
+        ps = self.config.cache.page_size
+        # pps >= the kernel's default chunk_pages (8): pallas_paged_decode
+        # caps its chunk at min(chunk_pages, pps), so a smaller probe would
+        # compile a different (smaller-scratch) kernel than serving runs and
+        # could pass while the real configuration fails.
+        B, pps = 4, 8
+        kd = cfg.num_kv_heads * cfg.head_dim
+        q = jnp.zeros((B, cfg.num_heads, cfg.head_dim), cfg.jnp_dtype)
+        pool = jnp.zeros((2, ps, kd), cfg.jnp_dtype)
+        tables = jnp.zeros((B, pps), jnp.int32)
+        ctx = jnp.ones((B,), jnp.int32)
+        cur = jnp.zeros((B, cfg.num_kv_heads, cfg.head_dim), cfg.jnp_dtype)
+        try:
+            jax.jit(lambda *a: pallas_paged_decode(
+                *a, cfg.head_dim ** -0.5)).lower(
+                    q, pool, pool, tables, ctx, cur, cur).compile()
+        except Exception as e:  # Mosaic errors are plain XlaRuntimeError
+            logger.warning(
+                "Pallas decode kernel failed probe compile (%s); "
+                "falling back to XLA attention", e)
+            return False
         return True
 
     # -- jitted step programs ----------------------------------------------
+
+    def _maybe_jit(self, fn, donate_argnums=()):
+        """jit unless ``enforce_eager`` (parity with vllm --enforce-eager):
+        eager mode runs the step op-by-op — no compile cache, no donation —
+        for debugging numerics/shape issues. Always slower."""
+        if self.config.enforce_eager:
+            return fn
+        return jax.jit(fn, donate_argnums=donate_argnums)
 
     def _build_prefill_fn(self):
         """Inputs arrive as TWO packed buffers (one int, one float) — each
@@ -159,7 +199,6 @@ class LLMEngine:
         cfg = self.model_config
         use_pallas = self.use_pallas
 
-        @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, kv: KVCache, int_t, int_b, float_b, key):
             meta = PrefillMeta(seg_ids=int_t[1], positions=int_t[2],
                                slot_mapping=int_t[3],
@@ -171,7 +210,7 @@ class LLMEngine:
                                         int_b[:, 1], float_b[:, 1])
             return next_tokens, kv
 
-        return prefill_step
+        return self._maybe_jit(prefill_step, donate_argnums=(1,))
 
     def _build_decode_fn(self):
         """Multi-step decode: W autoregressive steps inside one XLA program.
@@ -187,7 +226,6 @@ class LLMEngine:
         ps = self.config.cache.page_size
         max_len = self.config.effective_max_len
 
-        @partial(jax.jit, donate_argnums=(1,))
         def decode_window(params, kv: KVCache, tokens0, int_b, float_b, key):
             # tokens0: [B] — separate so chained windows can feed the previous
             # window's device-resident output column without a host roundtrip.
@@ -228,7 +266,7 @@ class LLMEngine:
                 substep, (kv, tokens0, positions0), jnp.arange(W))
             return toks.T, kv    # [B, W]
 
-        return decode_window
+        return self._maybe_jit(decode_window, donate_argnums=(1,))
 
     # -- public API ---------------------------------------------------------
 
@@ -251,8 +289,15 @@ class LLMEngine:
                         self.scheduler.running.remove(seq)
                     self._inflight["zombies"].add(request_id)
                     self._deferred_release.append(seq)
+                    self.stats.requests_finished += 1
                     return True
-        return self.scheduler.abort(request_id)
+        if self.scheduler.abort(request_id):
+            # Aborted sequences never reach _process_window's finish
+            # accounting — count them here or kgct_requests_finished_total
+            # drifts from kgct_requests_total.
+            self.stats.requests_finished += 1
+            return True
+        return False
 
     def has_unfinished_requests(self) -> bool:
         # An in-flight window must be drained even if every sequence finished
@@ -408,6 +453,7 @@ class LLMEngine:
         request that will never emit again."""
         outs = []
         for seq in self.scheduler.terminally_finished:
+            self.stats.requests_finished += 1
             outs.append(RequestOutput(
                 request_id=seq.request_id,
                 prompt_token_ids=seq.prompt_token_ids,

@@ -17,10 +17,6 @@ paged cache is native:
   copy-on-write-free refcounts, mirroring vLLM's block manager role. Page 0 is
   reserved as a scrap page: padding tokens write there so scatter updates need
   no masking inside jit.
-
-A C++ implementation of the allocator hot path lives in
-cluster/native (same algorithm) and is used when built; this Python version is
-the always-available reference implementation.
 """
 
 from __future__ import annotations

@@ -46,6 +46,15 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
     arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
     num_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
+    rope_scaling = None
+    if hf.get("rope_scaling"):
+        from ..ops.rope import scaled_inv_freq
+        raw = {k: v for k, v in hf["rope_scaling"].items()
+               if isinstance(v, (str, int, float, bool))}
+        # Validate NOW — an unsupported type (yarn, dynamic, ...) must fail
+        # the load, not silently serve with unscaled RoPE.
+        scaled_inv_freq(head_dim, float(hf.get("rope_theta", 10000.0)), raw)
+        rope_scaling = tuple(sorted(raw.items()))
     return ModelConfig(
         name=name or os.path.basename(os.path.normpath(path)),
         vocab_size=hf["vocab_size"],
@@ -56,6 +65,7 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
         num_kv_heads=hf.get("num_key_value_heads", num_heads),
         head_dim=head_dim,
         rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
         rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
         attention_bias=bool(hf.get("attention_bias",

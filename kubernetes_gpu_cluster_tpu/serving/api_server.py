@@ -144,12 +144,24 @@ class APIServer:
         stream = bool(body.get("stream"))
         self.metrics.on_request()
 
+        # ``complete`` guards the engine-side abort: any early handler exit —
+        # asyncio.CancelledError when aiohttp cancels the task on client
+        # disconnect, ConnectionResetError mid-SSE-write, any bug — must stop
+        # the request on-device, or an abandoned request keeps generating
+        # until max_tokens (a device-time leak under client churn).
         gen = self.engine.generate(rid, ids, params)
+        complete = False
         if not stream:
             try:
                 text, finish_reason, n_out = await self._collect(gen, detok, rid)
+                complete = True
             except ValueError as e:
+                complete = True      # engine already rejected/finished it
+                self.metrics.on_finish(0)  # a 400 is still a delivered response
                 return _error(400, str(e))
+            finally:
+                if not complete:
+                    self.engine.abort(rid)
             self.metrics.on_finish(n_out)
             return web.json_response(_response_body(
                 kind, rid, created, self.model_name, text, finish_reason,
@@ -174,12 +186,14 @@ class APIServer:
                         kind, rid, created, self.model_name, delta,
                         reason if finished else None)))
                 if finished:
+                    complete = True
                     break
         except ValueError as e:
+            complete = True
             await resp.write(_sse({"error": {"message": str(e), "code": 400}}))
-        except ConnectionResetError:
-            self.engine.abort(rid)
-            raise
+        finally:
+            if not complete:
+                self.engine.abort(rid)
         self.metrics.on_finish(n_out)
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()

@@ -105,6 +105,11 @@ class AsyncLLMEngine:
                     return
                 inbox, self._inbox = self._inbox, []
                 aborts, self._aborts = self._aborts, []
+            # A request whose add and abort arrived in the same wakeup must
+            # not be admitted: the abort would no-op (nothing to abort yet)
+            # and the request would then run orphaned to completion.
+            aborted = set(aborts)
+            inbox = [item for item in inbox if item[0] not in aborted]
             for rid in aborts:
                 self.engine.abort_request(rid)
                 self._post(StreamChunk(rid, [], [], True, "abort"))

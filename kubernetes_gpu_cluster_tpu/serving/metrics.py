@@ -20,6 +20,8 @@ class Metrics:
     def __init__(self, engine):
         self.engine = engine               # LLMEngine
         self.requests_total = 0
+        self.responses_total = 0
+        self.response_tokens_total = 0
         self._started = time.monotonic()
 
     # -- hooks called by the API layer --------------------------------------
@@ -28,7 +30,11 @@ class Metrics:
         self.requests_total += 1
 
     def on_finish(self, n_tokens: int) -> None:
-        pass  # engine-side stats already count tokens/finishes
+        """HTTP-layer completion: counts responses actually delivered to
+        clients (engine-side requests_finished also covers aborts/terminated
+        sequences, so the two legitimately differ under churn)."""
+        self.responses_total += 1
+        self.response_tokens_total += n_tokens
 
     # -- rendering ----------------------------------------------------------
 
@@ -41,6 +47,10 @@ class Metrics:
         lines = [
             "# TYPE kgct_requests_total counter",
             f"kgct_requests_total {self.requests_total}",
+            "# TYPE kgct_responses_total counter",
+            f"kgct_responses_total {self.responses_total}",
+            "# TYPE kgct_response_tokens_total counter",
+            f"kgct_response_tokens_total {self.response_tokens_total}",
             "# TYPE kgct_requests_finished_total counter",
             f"kgct_requests_finished_total {stats.requests_finished}",
             "# TYPE kgct_tokens_generated_total counter",

@@ -21,6 +21,7 @@ work for local/dev. Deployment manifests are rendered by cluster/chart.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import itertools
 from typing import Optional
 
@@ -122,8 +123,49 @@ class Router:
                          f"{int(r.healthy)}")
             lines.append(f'kgct_router_replica_inflight{{replica="{r.url}"}} '
                          f"{r.inflight}")
+        # Aggregate each healthy replica's engine metrics behind the single
+        # front door (one scrape target for the whole DP group), labelled by
+        # replica so series do not collide.
+        fetched = await asyncio.gather(
+            *(self._fetch_metrics(r) for r in self.replicas if r.healthy),
+            return_exceptions=True)
+        # One TYPE line per metric name across ALL replicas — duplicates make
+        # the whole exposition invalid to Prometheus parsers.
+        seen_types: set[str] = set()
+        for res in fetched:
+            if isinstance(res, BaseException):
+                continue
+            for kind, line in res:
+                if kind is None:
+                    lines.append(line)
+                elif kind not in seen_types:
+                    seen_types.add(kind)
+                    lines.append(line)
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
+
+    async def _fetch_metrics(self, replica: Replica):
+        """Returns (metric_name_or_None, line) pairs: name set for TYPE lines
+        (deduped by the caller), None for relabelled samples."""
+        async with self._session.get(f"{replica.url}/metrics",
+                                     timeout=aiohttp.ClientTimeout(total=5)
+                                     ) as resp:
+            text = await resp.text()
+        label = f'replica="{replica.url}"'
+        out = []
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                if line.startswith("# TYPE"):
+                    parts = line.split()
+                    out.append((parts[2] if len(parts) > 2 else line, line))
+                continue
+            name, _, rest = line.partition(" ")
+            if "{" in name:
+                base, _, labels = name.partition("{")
+                out.append((None, f"{base}{{{label},{labels} {rest}"))
+            else:
+                out.append((None, f"{name}{{{label}}} {rest}"))
+        return out
 
     # -- proxying ------------------------------------------------------------
 
@@ -143,9 +185,10 @@ class Router:
                 status=503)
         body = await request.read()
         replica.inflight += 1
+        resp: Optional[web.StreamResponse] = None
         try:
             async with self._session.request(
-                    request.method, f"{replica.url}{request.path}",
+                    request.method, f"{replica.url}{request.path_qs}",
                     data=body if body else None,
                     headers={k: v for k, v in request.headers.items()
                              if k.lower() not in HOP_HEADERS}) as upstream:
@@ -162,6 +205,13 @@ class Router:
             replica.consecutive_failures += 1
             if replica.consecutive_failures >= self.fail_threshold:
                 replica.healthy = False
+            if resp is not None and resp.prepared:
+                # The response already started streaming to the client — a
+                # fresh json_response on the same request would corrupt the
+                # wire. Terminate what we have; the truncation is the signal.
+                with contextlib.suppress(Exception):
+                    await resp.write_eof()
+                return resp
             return web.json_response(
                 {"error": {"message": f"upstream error: {e}", "code": 502}},
                 status=502)

@@ -1,0 +1,212 @@
+"""Interop tests for the C++ TPU device plugin (cluster/device-plugin).
+
+The plugin embeds its own gRPC/HTTP2/HPACK/protobuf stack (no deps), so these
+tests are wire-level interop proofs against PRODUCTION implementations:
+
+- the fake kubelet is a real grpcio server + protoc-generated v1beta1
+  messages: the plugin's Registration CLIENT must speak real gRPC to it;
+- the DevicePlugin service is driven by a real grpcio CLIENT: ListAndWatch /
+  Allocate / GetDevicePluginOptions responses must parse with libprotobuf.
+
+Covers the reference's device-plugin layer (reference README.md:90,
+old_README.md:1206-1318 — registration log signatures and allocation checks)
+as automated tests instead of runbook transcripts.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PLUGIN_DIR = REPO / "cluster" / "device-plugin"
+
+
+# -- build + protoc fixtures -------------------------------------------------
+
+@pytest.fixture(scope="module")
+def plugin_bin():
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    r = subprocess.run(["make", "-C", str(PLUGIN_DIR)], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, f"device plugin build failed:\n{r.stderr}"
+    return PLUGIN_DIR / "build" / "kgct-tpu-device-plugin"
+
+
+@pytest.fixture(scope="module")
+def pb():
+    if shutil.which("protoc") is None:
+        pytest.skip("no protoc")
+    out = tempfile.mkdtemp(prefix="kgct-proto-")
+    r = subprocess.run(
+        ["protoc", f"--python_out={out}", "v1beta1.proto"],
+        cwd=PLUGIN_DIR / "proto", capture_output=True, text=True)
+    assert r.returncode == 0, f"protoc failed:\n{r.stderr}"
+    sys.path.insert(0, out)
+    try:
+        import v1beta1_pb2  # noqa: E402
+        yield v1beta1_pb2
+    finally:
+        sys.path.remove(out)
+
+
+class FakeKubelet:
+    """grpcio server on <dir>/kubelet.sock implementing v1beta1.Registration."""
+
+    def __init__(self, pb, plugin_dir: str):
+        self.pb = pb
+        self.requests: list = []
+        self.event = threading.Event()
+        handler = grpc.method_handlers_generic_handler(
+            "v1beta1.Registration",
+            {"Register": grpc.unary_unary_rpc_method_handler(
+                self._register,
+                request_deserializer=pb.RegisterRequest.FromString,
+                response_serializer=pb.Empty.SerializeToString)})
+        self.server = grpc.server(
+            __import__("concurrent.futures", fromlist=["ThreadPoolExecutor"])
+            .ThreadPoolExecutor(max_workers=2))
+        self.server.add_generic_rpc_handlers((handler,))
+        self.server.add_insecure_port(f"unix://{plugin_dir}/kubelet.sock")
+        self.server.start()
+
+    def _register(self, request, context):
+        self.requests.append(request)
+        self.event.set()
+        return self.pb.Empty()
+
+    def stop(self):
+        self.server.stop(0)
+
+
+@pytest.fixture()
+def harness(plugin_bin, pb, tmp_path):
+    """Fake devices + fake kubelet + running plugin; yields (pb, dirs, proc)."""
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    for i in range(4):
+        (devdir / f"accel{i}").touch()
+    plugdir = tmp_path / "plugins"
+    plugdir.mkdir()
+
+    kubelet = FakeKubelet(pb, str(plugdir))
+    proc = subprocess.Popen(
+        [str(plugin_bin), f"--plugin-dir={plugdir}", f"--dev-root={devdir}",
+         "--health-interval-s=1"],
+        stderr=subprocess.PIPE, text=True)
+    try:
+        yield pb, devdir, plugdir, kubelet, proc
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        kubelet.stop()
+
+
+def _channel(plugdir):
+    ch = grpc.insecure_channel(f"unix://{plugdir}/kgct-tpu.sock")
+    grpc.channel_ready_future(ch).result(timeout=10)
+    return ch
+
+
+# -- tests -------------------------------------------------------------------
+
+def test_registers_with_kubelet(harness):
+    pb, _, _, kubelet, proc = harness
+    assert kubelet.event.wait(timeout=15), (
+        "plugin did not register; stderr:\n" + proc.stderr.read())
+    req = kubelet.requests[0]
+    assert req.version == "v1beta1"
+    assert req.endpoint == "kgct-tpu.sock"
+    assert req.resource_name == "google.com/tpu"
+    assert not req.options.pre_start_required
+
+
+def test_list_and_watch_and_allocate(harness):
+    pb, devdir, plugdir, kubelet, proc = harness
+    assert kubelet.event.wait(timeout=15)
+    ch = _channel(plugdir)
+
+    # GetDevicePluginOptions (unary, empty-message round trip).
+    opts = ch.unary_unary(
+        "/v1beta1.DevicePlugin/GetDevicePluginOptions",
+        request_serializer=pb.Empty.SerializeToString,
+        response_deserializer=pb.DevicePluginOptions.FromString,
+    )(pb.Empty(), timeout=10)
+    assert not opts.get_preferred_allocation_available
+
+    # ListAndWatch: first streamed inventory.
+    stream = ch.unary_stream(
+        "/v1beta1.DevicePlugin/ListAndWatch",
+        request_serializer=pb.Empty.SerializeToString,
+        response_deserializer=pb.ListAndWatchResponse.FromString,
+    )(pb.Empty(), timeout=30)
+    first = next(iter(stream))
+    ids = sorted(d.ID for d in first.devices)
+    assert ids == ["accel0", "accel1", "accel2", "accel3"]
+    assert all(d.health == "Healthy" for d in first.devices)
+
+    # Allocate two chips: device specs + TPU_VISIBLE_CHIPS env.
+    req = pb.AllocateRequest()
+    creq = req.container_requests.add()
+    creq.devicesIDs.extend(["accel1", "accel3"])
+    resp = ch.unary_unary(
+        "/v1beta1.DevicePlugin/Allocate",
+        request_serializer=pb.AllocateRequest.SerializeToString,
+        response_deserializer=pb.AllocateResponse.FromString,
+    )(req, timeout=10)
+    assert len(resp.container_responses) == 1
+    cr = resp.container_responses[0]
+    assert {d.host_path for d in cr.devices} == {
+        f"{devdir}/accel1", f"{devdir}/accel3"}
+    assert {d.container_path for d in cr.devices} == {
+        "/dev/accel1", "/dev/accel3"}
+    assert all(d.permissions == "rw" for d in cr.devices)
+    assert cr.envs["TPU_VISIBLE_CHIPS"] == "1,3"
+    ch.close()
+
+
+def test_health_change_pushes_update(harness):
+    pb, devdir, plugdir, kubelet, proc = harness
+    assert kubelet.event.wait(timeout=15)
+    ch = _channel(plugdir)
+    stream = ch.unary_stream(
+        "/v1beta1.DevicePlugin/ListAndWatch",
+        request_serializer=pb.Empty.SerializeToString,
+        response_deserializer=pb.ListAndWatchResponse.FromString,
+    )(pb.Empty(), timeout=30)
+    it = iter(stream)
+    first = next(it)
+    assert len(first.devices) == 4
+
+    (devdir / "accel2").unlink()          # chip disappears
+    second = next(it)                     # pushed within health-interval (1s)
+    ids = sorted(d.ID for d in second.devices)
+    assert ids == ["accel0", "accel1", "accel3"]
+    ch.close()
+
+
+def test_allocate_unknown_device_fails(harness):
+    pb, _, plugdir, kubelet, proc = harness
+    assert kubelet.event.wait(timeout=15)
+    ch = _channel(plugdir)
+    req = pb.AllocateRequest()
+    req.container_requests.add().devicesIDs.append("accel9")
+    with pytest.raises(grpc.RpcError) as e:
+        ch.unary_unary(
+            "/v1beta1.DevicePlugin/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )(req, timeout=10)
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+    ch.close()
