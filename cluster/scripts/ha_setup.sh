@@ -1,0 +1,177 @@
+#!/usr/bin/env bash
+# ha_setup.sh — HA control plane: keepalived VRRP VIP + haproxy apiserver LB.
+#
+# Completes the CONTROL_PLANE_ENDPOINT path of tpu_node_setup.sh with the
+# reference's multi-control-plane recipe (reference multi-cp.md:196-291),
+# templated instead of hand-edited: keepalived holds a virtual IP on the
+# healthiest control-plane node (VRRP, apiserver healthz tracked), haproxy
+# round-robins TCP :<port> across every apiserver with TLS healthz checks.
+#
+# Run on EACH control-plane node, then init the first one through the VIP:
+#   sudo bash ha_setup.sh --vip=10.0.0.250 --cp-ips=10.0.0.1,10.0.0.2,10.0.0.3 \
+#        --interface=ens3 --state=MASTER --priority=101
+#   CONTROL_PLANE_ENDPOINT=10.0.0.250:8443 \
+#        sudo bash tpu_node_setup.sh --yes --role=control_plane
+#   (remaining CPs: --state=BACKUP --priority=100,99 + kubeadm join --control-plane)
+#
+# DRY_RUN=1 prints the rendered configs without touching the system.
+set -euo pipefail
+
+VIP=""
+INTERFACE="${INTERFACE:-eth0}"
+STATE="${STATE:-MASTER}"           # MASTER on one node, BACKUP elsewhere
+PRIORITY="${PRIORITY:-101}"        # highest wins the VIP
+VRID="${VRID:-51}"
+CP_IPS=""                          # comma-separated apiserver IPs
+LB_PORT="${LB_PORT:-8443}"         # haproxy bind (8443: co-located with
+                                   # apiserver:6443 on the same nodes)
+API_PORT="${API_PORT:-6443}"
+AUTH_PASS="${AUTH_PASS:-}"         # VRRP auth; generated if empty
+DRY_RUN="${DRY_RUN:-0}"
+
+log()  { echo -e "\e[32m[ha-setup]\e[0m $*"; }
+err()  { echo -e "\e[31m[ha-setup]\e[0m $*" >&2; }
+run()  { if [[ "$DRY_RUN" == "1" ]]; then echo "DRY: $*"; else "$@"; fi }
+
+usage() { grep '^#' "$0" | head -20; exit 1; }
+
+for arg in "$@"; do
+  case "$arg" in
+    --vip=*) VIP="${arg#*=}" ;;
+    --interface=*) INTERFACE="${arg#*=}" ;;
+    --state=*) STATE="${arg#*=}" ;;
+    --priority=*) PRIORITY="${arg#*=}" ;;
+    --vrid=*) VRID="${arg#*=}" ;;
+    --cp-ips=*) CP_IPS="${arg#*=}" ;;
+    --lb-port=*) LB_PORT="${arg#*=}" ;;
+    --api-port=*) API_PORT="${arg#*=}" ;;
+    --help|-h) usage ;;
+    *) err "unknown flag: $arg"; usage ;;
+  esac
+done
+
+[[ -z "$VIP" ]] && { err "--vip=<virtual ip> required"; exit 1; }
+[[ -z "$CP_IPS" ]] && { err "--cp-ips=<ip1,ip2,...> required"; exit 1; }
+[[ "$STATE" == "MASTER" || "$STATE" == "BACKUP" ]] \
+  || { err "--state must be MASTER or BACKUP"; exit 1; }
+if [[ -z "$AUTH_PASS" ]]; then
+  # VRRP auth_pass uses only the first 8 chars; random beats the reference's
+  # hardcoded literal (multi-cp.md:257).
+  AUTH_PASS=$(head -c6 /dev/urandom | base64 | tr -dc 'a-zA-Z0-9' | head -c8)
+  log "generated VRRP auth_pass (must MATCH on all control-plane nodes: " \
+      "pass AUTH_PASS=... explicitly)"
+fi
+
+render_haproxy() {  # reference multi-cp.md:196-238
+  cat <<EOF
+global
+    log stdout format raw local0
+    daemon
+
+defaults
+    log     global
+    mode    tcp
+    option  tcplog
+    timeout connect 5s
+    timeout client  30s
+    timeout server  30s
+
+frontend apiserver
+    bind *:$LB_PORT
+    mode tcp
+    option tcplog
+    default_backend apiserverbackend
+
+backend apiserverbackend
+    option httpchk
+    http-check connect ssl
+    http-check send meth GET uri /healthz
+    http-check expect status 200
+    mode tcp
+    balance roundrobin
+EOF
+  local i=1
+  for ip in ${CP_IPS//,/ }; do
+    echo "    server cp$i $ip:$API_PORT check verify none"
+    i=$((i+1))
+  done
+}
+
+render_keepalived() {  # reference multi-cp.md:240-269
+  cat <<EOF
+global_defs {
+    router_id kgct_ha
+}
+vrrp_script check_apiserver {
+    script "/etc/keepalived/check_apiserver.sh"
+    interval 3
+    weight -2
+    fall 10
+    rise 2
+}
+
+vrrp_instance VI_1 {
+    state $STATE
+    interface $INTERFACE
+    virtual_router_id $VRID
+    priority $PRIORITY
+    authentication {
+        auth_type PASS
+        auth_pass $AUTH_PASS
+    }
+    virtual_ipaddress {
+        $VIP
+    }
+    track_script {
+        check_apiserver
+    }
+}
+EOF
+}
+
+render_check() {  # reference multi-cp.md:275-285
+  cat <<EOF
+#!/bin/sh
+# keepalived health probe: drop VRRP priority when the local apiserver
+# (or, on the VIP holder, the VIP-routed apiserver) stops answering healthz.
+errorExit() { echo "*** \$*" 1>&2; exit 1; }
+curl -sfk --max-time 2 https://localhost:$API_PORT/healthz -o /dev/null \\
+  || errorExit "Error GET https://localhost:$API_PORT/healthz"
+if ip addr | grep -q "$VIP"; then
+  curl -sfk --max-time 2 https://$VIP:$LB_PORT/healthz -o /dev/null \\
+    || errorExit "Error GET https://$VIP:$LB_PORT/healthz"
+fi
+EOF
+}
+
+main() {
+  log "HA control plane: VIP=$VIP state=$STATE priority=$PRIORITY lb=:$LB_PORT"
+  if [[ "$DRY_RUN" == "1" ]]; then
+    echo "DRY: apt-get install -y keepalived haproxy"
+    echo "=== /etc/haproxy/haproxy.cfg ==="
+    render_haproxy
+    echo "=== /etc/keepalived/keepalived.conf ==="
+    render_keepalived
+    echo "=== /etc/keepalived/check_apiserver.sh ==="
+    render_check
+    echo "DRY: systemctl enable --now haproxy keepalived"
+    log "init via VIP: CONTROL_PLANE_ENDPOINT=$VIP:$LB_PORT tpu_node_setup.sh --role=control_plane"
+    return 0
+  fi
+  apt-get install -y keepalived haproxy
+  render_haproxy > /etc/haproxy/haproxy.cfg
+  mkdir -p /etc/keepalived
+  render_keepalived > /etc/keepalived/keepalived.conf
+  render_check > /etc/keepalived/check_apiserver.sh
+  chmod +x /etc/keepalived/check_apiserver.sh
+  systemctl enable --now haproxy
+  systemctl restart haproxy
+  systemctl enable --now keepalived
+  systemctl restart keepalived
+  log "HA stack up. Initialize the FIRST control plane with:"
+  log "  CONTROL_PLANE_ENDPOINT=$VIP:$LB_PORT sudo bash tpu_node_setup.sh --yes --role=control_plane"
+  log "Join further control planes with the --control-plane join command"
+  log "from 'kubeadm init' output (certs uploaded via --upload-certs)."
+}
+
+main

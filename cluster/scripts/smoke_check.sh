@@ -1,0 +1,208 @@
+#!/usr/bin/env bash
+# smoke_check.sh — automated acceptance checks for a kgct TPU cluster.
+#
+# The reference's quality story was a ladder of MANUAL smoke checks with
+# expected outputs pasted in runbooks (SURVEY §4's table: proxy curl
+# README.md:28-31, runtime up README.md:49, port preconditions
+# old_README.md:124-142, node Ready README.md:63-75, allocatable-GPU query
+# old_README.md:569-574, in-pod device audit old_README.md:1014-1023, CUDA
+# vectoradd acceptance old_README.md:716-734). This script IS that table,
+# executable: each row is a check function printing PASS/FAIL/SKIP; exit
+# code = number of failures.
+#
+# Usage:
+#   bash smoke_check.sh                  # run everything applicable
+#   bash smoke_check.sh proxy runtime    # run specific checks
+#   DRY_RUN=1 bash smoke_check.sh        # print what would run
+#   ACCEPTANCE_IMAGE=... bash smoke_check.sh acceptance
+set -uo pipefail
+
+DRY_RUN="${DRY_RUN:-0}"
+PROXY_URL="${PROXY_URL:-http://127.0.0.1:8118}"
+CRI_SOCKET="${CRI_SOCKET:-unix:///run/containerd/containerd.sock}"
+# Image for the acceptance pod: anything with python3 + jax (the serving
+# image works; any jax-on-tpu image does).
+ACCEPTANCE_IMAGE="${ACCEPTANCE_IMAGE:-ghcr.io/kgct/tpu-serving:v0.3.0}"
+ACCEPTANCE_TIMEOUT="${ACCEPTANCE_TIMEOUT:-300s}"
+
+PASS=0; FAIL=0; SKIP=0
+pass() { echo "PASS  $1"; PASS=$((PASS+1)); }
+fail() { echo "FAIL  $1${2:+ — $2}"; FAIL=$((FAIL+1)); }
+skip() { echo "SKIP  $1${2:+ — $2}"; SKIP=$((SKIP+1)); }
+
+dry() { [[ "$DRY_RUN" == "1" ]]; }
+
+# --- row 1: proxy egress (reference README.md:28-31) ------------------------
+check_proxy() {
+  dry && { echo "DRY: curl --proxy $PROXY_URL https://ipinfo.io/ip"; return; }
+  if ! command -v curl >/dev/null; then skip proxy "no curl"; return; fi
+  if curl -fs --max-time 10 --proxy "$PROXY_URL" https://ipinfo.io/ip >/dev/null; then
+    pass "proxy egress via $PROXY_URL"
+  else
+    skip "proxy egress" "no proxy at $PROXY_URL (fine on open networks)"
+  fi
+}
+
+# --- row 2: container runtime up (reference README.md:49) -------------------
+check_runtime() {
+  dry && { echo "DRY: systemctl is-active containerd; crictl version"; return; }
+  if systemctl is-active --quiet containerd 2>/dev/null \
+     || systemctl is-active --quiet crio 2>/dev/null; then
+    pass "container runtime active"
+  else
+    fail "container runtime active" "neither containerd nor crio running"
+  fi
+  if command -v crictl >/dev/null; then
+    if crictl --runtime-endpoint "$CRI_SOCKET" version >/dev/null 2>&1; then
+      pass "CRI socket answers ($CRI_SOCKET)"
+    else
+      fail "CRI socket answers" "$CRI_SOCKET"
+    fi
+  else
+    skip "CRI socket answers" "no crictl"
+  fi
+}
+
+# --- row 3: port preconditions pre-init (reference old_README.md:124-142) ---
+check_ports() {
+  dry && { echo "DRY: ss -lptn sport = :6443"; return; }
+  if ! command -v ss >/dev/null; then skip ports "no ss"; return; fi
+  if kubectl get nodes >/dev/null 2>&1; then
+    # cluster running: 6443 SHOULD be listening
+    if ss -ltn 'sport = :6443' | grep -q 6443; then
+      pass "apiserver listening on 6443"
+    else
+      fail "apiserver listening on 6443"
+    fi
+  else
+    if ss -ltn 'sport = :6443' | grep -q 6443; then
+      fail "port 6443 free pre-init" "something is listening"
+    else
+      pass "port 6443 free pre-init"
+    fi
+  fi
+}
+
+# --- row 4: node Ready (reference README.md:63-75) --------------------------
+check_nodes() {
+  dry && { echo "DRY: kubectl get nodes -> all Ready"; return; }
+  command -v kubectl >/dev/null || { skip nodes "no kubectl"; return; }
+  kubectl get nodes >/dev/null 2>&1 || { skip nodes "no cluster"; return; }
+  local notready
+  notready=$(kubectl get nodes --no-headers 2>/dev/null | awk '$2 != "Ready"' | wc -l)
+  if [[ "$notready" == "0" ]]; then
+    pass "all nodes Ready"
+  else
+    fail "all nodes Ready" "$notready node(s) not Ready"
+  fi
+}
+
+# --- row 5: allocatable TPU (reference old_README.md:569-574) ---------------
+check_allocatable() {
+  dry && { echo "DRY: kubectl get nodes -o custom-columns=TPU:.status.allocatable.google\\.com/tpu"; return; }
+  command -v kubectl >/dev/null || { skip allocatable "no kubectl"; return; }
+  kubectl get nodes >/dev/null 2>&1 || { skip allocatable "no cluster"; return; }
+  local total
+  total=$(kubectl get nodes -o jsonpath='{range .items[*]}{.status.allocatable.google\.com/tpu}{"\n"}{end}' \
+          2>/dev/null | awk '{s+=$1} END {print s+0}')
+  if [[ "${total:-0}" -gt 0 ]]; then
+    pass "allocatable google.com/tpu = $total"
+  else
+    fail "allocatable google.com/tpu" "0 — is the device plugin DaemonSet running?"
+  fi
+}
+
+# --- row 6: device plugin registered (reference old_README.md:1206-1318) ----
+check_device_plugin() {
+  dry && { echo "DRY: kubectl -n kube-system logs ds/kgct-tpu-device-plugin | grep registered"; return; }
+  command -v kubectl >/dev/null || { skip device-plugin "no kubectl"; return; }
+  kubectl get ds -n kube-system kgct-tpu-device-plugin >/dev/null 2>&1 \
+    || { skip device-plugin "DaemonSet not applied"; return; }
+  if kubectl -n kube-system logs ds/kgct-tpu-device-plugin --tail=200 2>/dev/null \
+       | grep -q "registered google.com/tpu"; then
+    pass "device plugin registered with kubelet"
+  else
+    fail "device plugin registered" "no registration line in logs"
+  fi
+}
+
+# --- row 7: end-to-end TPU acceptance pod (reference old_README.md:716-734,
+#            the CUDA vectoradd analogue: tiny JAX matmul on 1 chip) --------
+check_acceptance() {
+  local manifest
+  manifest=$(cat <<EOF
+apiVersion: v1
+kind: Pod
+metadata:
+  name: kgct-tpu-acceptance
+spec:
+  restartPolicy: Never
+  containers:
+    - name: matmul
+      image: $ACCEPTANCE_IMAGE
+      command: ["python3", "-c"]
+      args:
+        - |
+          import jax, jax.numpy as jnp
+          assert jax.default_backend() == "tpu", jax.default_backend()
+          x = jnp.ones((1024, 1024), jnp.bfloat16)
+          y = (x @ x).block_until_ready()
+          assert float(y[0, 0]) == 1024.0, y[0, 0]
+          print("TPU MATMUL OK on", jax.devices())
+      resources:
+        limits:
+          google.com/tpu: 1
+EOF
+)
+  dry && { echo "DRY: kubectl apply TPU acceptance pod (google.com/tpu: 1) + wait $ACCEPTANCE_TIMEOUT"; return; }
+  command -v kubectl >/dev/null || { skip acceptance "no kubectl"; return; }
+  kubectl get nodes >/dev/null 2>&1 || { skip acceptance "no cluster"; return; }
+  kubectl delete pod kgct-tpu-acceptance --ignore-not-found >/dev/null 2>&1
+  echo "$manifest" | kubectl apply -f - >/dev/null || { fail acceptance "apply failed"; return; }
+  if kubectl wait --for=jsonpath='{.status.phase}'=Succeeded \
+       pod/kgct-tpu-acceptance --timeout="$ACCEPTANCE_TIMEOUT" >/dev/null 2>&1 \
+     && kubectl logs kgct-tpu-acceptance | grep -q "TPU MATMUL OK"; then
+    pass "TPU acceptance pod (matmul on google.com/tpu: 1)"
+  else
+    fail "TPU acceptance pod" "$(kubectl get pod kgct-tpu-acceptance \
+      -o jsonpath='{.status.phase}' 2>/dev/null)"
+  fi
+  kubectl delete pod kgct-tpu-acceptance --ignore-not-found >/dev/null 2>&1
+}
+
+# --- row 8: serving E2E (reference old_README.md:1174-1176,1472-1476) -------
+check_serving() {
+  dry && { echo "DRY: curl kgct-router-service /health + /v1/models"; return; }
+  command -v kubectl >/dev/null || { skip serving "no kubectl"; return; }
+  kubectl get svc kgct-router-service >/dev/null 2>&1 \
+    || { skip serving "router service not deployed"; return; }
+  local out
+  out=$(kubectl run kgct-curl-probe --rm -i --restart=Never --quiet \
+        --image=curlimages/curl -- \
+        -fs --max-time 10 http://kgct-router-service/health 2>/dev/null)
+  if [[ "$out" == *'"status"'* ]]; then
+    pass "router /health answers in-cluster"
+  else
+    fail "router /health answers" "$out"
+  fi
+}
+
+ALL_CHECKS=(proxy runtime ports nodes allocatable device_plugin acceptance serving)
+
+main() {
+  local checks=("${@:-}")
+  [[ -z "${checks[0]:-}" ]] && checks=("${ALL_CHECKS[@]}")
+  for c in "${checks[@]}"; do
+    c="${c//-/_}"
+    if declare -F "check_$c" >/dev/null; then
+      "check_$c"
+    else
+      echo "unknown check: $c (known: ${ALL_CHECKS[*]})"; exit 2
+    fi
+  done
+  echo "----"
+  echo "smoke: $PASS passed, $FAIL failed, $SKIP skipped"
+  exit "$FAIL"
+}
+
+main "$@"

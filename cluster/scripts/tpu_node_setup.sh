@@ -30,7 +30,13 @@ set -euo pipefail
 KUBE_VERSION="${KUBE_VERSION:-v1.33}"
 CRI_SOCKET="${CRI_SOCKET:-unix:///run/containerd/containerd.sock}"
 POD_CIDR="${POD_CIDR:-10.244.0.0/16}"
+SERVICE_CIDR="${SERVICE_CIDR:-10.96.0.0/12}"
 HTTP_PROXY_URL="${HTTP_PROXY_URL:-}"     # optional egress proxy (proxy_setup.sh)
+# CNI: pinned Calico (the reference's choice, v3.28 — reference README.md:78,
+# node-Ready gate journaled old_README.md:365-399). APPLY_CNI=0 to skip.
+APPLY_CNI="${APPLY_CNI:-1}"
+CALICO_VERSION="${CALICO_VERSION:-v3.28.0}"
+CNI_MANIFEST="${CNI_MANIFEST:-https://raw.githubusercontent.com/projectcalico/calico/$CALICO_VERSION/manifests/calico.yaml}"
 ROLE=""
 JOIN_CMD=""
 ASSUME_YES=0
@@ -143,7 +149,13 @@ setup_runtime() {
   if ! command -v containerd >/dev/null && [[ "$DRY_RUN" != "1" ]]; then
     err "containerd not installed; run runtime_setup.sh first"; exit 1
   fi
-  if [[ "$DRY_RUN" == "1" ]]; then echo "DRY: configure containerd"; return; fi
+  if [[ "$DRY_RUN" == "1" ]]; then
+    echo "DRY: configure containerd (SystemdCgroup=true)"
+    if [[ -n "$HTTP_PROXY_URL" ]]; then
+      echo "DRY: containerd http-proxy.conf NO_PROXY=$(no_proxy_value)"
+    fi
+    return 0
+  fi
   mkdir -p /etc/containerd
   if ! containerd config dump 2>/dev/null | grep -q "SystemdCgroup = true"; then
     containerd config default \
@@ -159,12 +171,16 @@ setup_runtime() {
 [Service]
 Environment="HTTP_PROXY=$HTTP_PROXY_URL"
 Environment="HTTPS_PROXY=$HTTP_PROXY_URL"
-Environment="NO_PROXY=localhost,127.0.0.1,10.0.0.0/8,$POD_CIDR,.svc,.cluster.local"
+Environment="NO_PROXY=$(no_proxy_value)"
 EOF
   fi
   systemctl daemon-reload
   systemctl enable --now containerd
   systemctl restart containerd
+}
+
+no_proxy_value() {  # single source of truth, visible to DRY_RUN golden tests
+  echo "localhost,127.0.0.1,10.0.0.0/8,$POD_CIDR,$SERVICE_CIDR,.svc,.cluster.local"
 }
 
 # ---------------------------------------------------------------------------
@@ -248,9 +264,28 @@ init_control_plane() {
     detect_tpu
     label_node "$(hostname | tr '[:upper:]' '[:lower:]')" || true
   fi
+  apply_cni
   log "control plane up. Next:"
-  log "  kubectl apply -f <CNI manifest>   # e.g. flannel/calico for $POD_CIDR"
   log "  kubectl apply -f cluster/device-plugin/manifest/daemonset.yaml"
+  log "  bash cluster/scripts/smoke_check.sh   # automated acceptance checks"
+}
+
+apply_cni() {  # pinned CNI + node-Ready gate (reference README.md:78,
+               # watch flow old_README.md:365-399; was a manual step there)
+  if [[ "$APPLY_CNI" != "1" ]]; then
+    log "APPLY_CNI=0: skipping CNI; apply one for $POD_CIDR before joining nodes"
+    return
+  fi
+  log "applying CNI: $CNI_MANIFEST"
+  run kubectl apply -f "$CNI_MANIFEST"
+  [[ "$DRY_RUN" == "1" ]] && { echo "DRY: wait for node Ready"; return; }
+  log "waiting for node Ready (CNI up)"
+  if ! kubectl wait --for=condition=Ready node --all --timeout=300s; then
+    warn "node not Ready after 300s — inspect CNI pods:"
+    warn "  kubectl get pods -n kube-system -o wide"
+    return 1
+  fi
+  log "node Ready"
 }
 
 post_init_kubeconfig() {  # reference k8s_setup.sh:320-334

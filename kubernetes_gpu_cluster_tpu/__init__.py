@@ -20,10 +20,12 @@ Subpackages:
     engine    — paged KV cache, continuous-batching scheduler, LLMEngine
     parallel  — mesh/sharding, TP/PP/EP/DP over ICI & DCN, jax.distributed bootstrap
     serving   — OpenAI-compatible API server, router, tokenizer, metrics
+    deploy    — values-schema renderer emitting the k8s deployment manifests
     utils     — logging, math helpers
 
-The ops layer (bootstrap scripts, TPU device plugin, deployment chart, HA)
-lives in the repo-root ``cluster/`` directory, not as a Python subpackage.
+The node-level ops layer lives in the repo-root ``cluster/`` directory:
+``cluster/scripts/`` (reset-first bootstrap, runtime, proxy) and
+``cluster/device-plugin/`` (the C++ kubelet device plugin + DaemonSet).
 """
 
 __version__ = "0.1.0"

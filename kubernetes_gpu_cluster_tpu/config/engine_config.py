@@ -4,8 +4,9 @@ Field names deliberately mirror the operator-facing knobs of the reference's
 Helm values schema (``vllmConfig`` in ``values-01-minimal-example8.yaml:24-38``):
 ``tensorParallelSize`` -> ParallelConfig.tp, ``pipelineParallelSize`` -> .pp,
 ``gpuMemoryUtilization`` -> CacheConfig.hbm_utilization, ``maxModelLen`` ->
-EngineConfig.max_model_len — so the deployment surface (cluster/helm) can map
-reference values files 1:1 onto this engine.
+EngineConfig.max_model_len — so the deployment surface
+(kubernetes_gpu_cluster_tpu.deploy.render) maps reference values files 1:1
+onto this engine; tests/test_deploy.py renders all nine.
 """
 
 from __future__ import annotations

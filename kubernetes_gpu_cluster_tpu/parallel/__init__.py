@@ -8,8 +8,9 @@ plus 10Gi ``/dev/shm``), and Ray/KubeRay for cross-node pipeline parallelism
 
 Design (SURVEY §2 "Parallelism strategies" obligations):
 
-- **mesh.py** — one `jax.sharding.Mesh` with axes ``("dp", "pp", "ep", "tp")``;
-  TP innermost so it rides ICI, DP/PP outermost so they may cross hosts over
+- **mesh.py** — one `jax.sharding.Mesh` with axes
+  ``("dp", "pp", "ep", "sp", "tp")``; TP innermost so it rides ICI, sp next
+  so ring hops stay on-slice, DP/PP outermost so they may cross hosts over
   DCN. Multi-host bootstrap via `jax.distributed` with stable-DNS coordinator
   discovery (the JobSet pattern replacing `kubeadm token` ssh plumbing).
 - **sharding.py** — GSPMD sharding-by-annotation for TP and EP: params and the
@@ -19,6 +20,9 @@ Design (SURVEY §2 "Parallelism strategies" obligations):
   stacked layer weights sharded over ``pp`` on the layer axis, microbatched
   hidden states rotating stage-to-stage via `lax.ppermute`.
 - **ep.py** — expert parallelism helpers for the mixtral-class MoE block.
+- **sp.py** — sequence/context parallelism: ring attention over the ``sp``
+  axis for long-context prefill (capability the reference lacked entirely —
+  it capped context instead, SURVEY §5 "Long-context").
 """
 
 from .mesh import make_mesh, initialize_distributed, mesh_from_config

@@ -4,11 +4,11 @@ Replaces the reference's Ray head/worker process model (reference
 ``old_README.md:1615-1625``) with `jax.distributed` SPMD processes, and its
 NCCL fabric with XLA collectives over ICI (intra-slice) / DCN (cross-slice).
 
-Axis order is ``("dp", "pp", "ep", "tp")`` — innermost (fastest-varying over
-the device list) last, so TP ranks land on ICI-adjacent chips within a slice
-while DP/PP cross slice (DCN) boundaries. This is the standard TPU layout:
-bandwidth-hungry tensor-parallel collectives stay on ICI, latency-tolerant
-pipeline hops ride DCN.
+Axis order is ``("dp", "pp", "ep", "sp", "tp")`` — innermost (fastest-varying
+over the device list) last, so TP ranks land on ICI-adjacent chips within a
+slice, sp ring neighbors sit one hop apart, while DP/PP cross slice (DCN)
+boundaries. This is the standard TPU layout: bandwidth-hungry tensor-parallel
+collectives stay on ICI, latency-tolerant pipeline hops ride DCN.
 """
 
 from __future__ import annotations
@@ -24,7 +24,7 @@ from ..utils import get_logger
 
 logger = get_logger("parallel.mesh")
 
-MESH_AXES = ("dp", "pp", "ep", "tp")
+MESH_AXES = ("dp", "pp", "ep", "sp", "tp")
 
 
 def make_mesh(
@@ -32,18 +32,21 @@ def make_mesh(
     pp: int = 1,
     dp: int = 1,
     ep: int = 1,
+    sp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> jax.sharding.Mesh:
     """Build the serving mesh. ``devices`` defaults to all visible devices;
-    world size must equal dp*pp*ep*tp."""
+    world size must equal dp*pp*ep*sp*tp. ``sp`` is the sequence/context-
+    parallel axis (ring attention, parallel/sp.py) — adjacent to tp so ring
+    hops ride ICI neighbors."""
     if devices is None:
         devices = jax.devices()
-    world = dp * pp * ep * tp
+    world = dp * pp * ep * sp * tp
     if len(devices) < world:
         raise ValueError(
-            f"need {world} devices for dp={dp} pp={pp} ep={ep} tp={tp}, "
-            f"have {len(devices)}")
-    devs = np.asarray(devices[:world]).reshape(dp, pp, ep, tp)
+            f"need {world} devices for dp={dp} pp={pp} ep={ep} sp={sp} "
+            f"tp={tp}, have {len(devices)}")
+    devs = np.asarray(devices[:world]).reshape(dp, pp, ep, sp, tp)
     return jax.sharding.Mesh(devs, MESH_AXES)
 
 

@@ -274,11 +274,20 @@ def build_server(config: EngineConfig, tokenizer_path: Optional[str] = None,
 
 def main(argv: Optional[list[str]] = None) -> None:
     """CLI: python -m kubernetes_gpu_cluster_tpu.serving.api_server
-    --model tinyllama-1.1b --port 8000 [--tokenizer /models/TinyLlama]"""
+    --model tinyllama-1.1b --port 8000 [--tokenizer /models/TinyLlama]
+
+    Flag names mirror the reference's vllmConfig/extraArgs surface
+    (values-01-minimal-example8.yaml:24-38) so cluster/deploy-rendered
+    manifests — and operators' muscle memory — carry over: --tensor-parallel-
+    size, --pipeline-parallel-size, --gpu-memory-utilization (alias of
+    --hbm-utilization), --max-model-len, --dtype, --enforce-eager. GPU-only
+    knobs the reference files carry (--disable-custom-all-reduce,
+    --trust-remote-code) are accepted and ignored with a notice: ICI
+    collectives have no custom-allreduce path and checkpoints are local."""
     import argparse
 
-    from ..config import get_model_config
-    from ..parallel import initialize_distributed
+    from ..config import CacheConfig, ParallelConfig, get_model_config
+    from ..parallel import initialize_distributed, make_mesh
 
     p = argparse.ArgumentParser()
     p.add_argument("--model", required=True)
@@ -289,6 +298,23 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--max-model-len", type=int, default=None)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--pipeline-parallel-size", type=int, default=1)
+    p.add_argument("--hbm-utilization", "--gpu-memory-utilization",
+                   dest="hbm_utilization", type=float, default=0.90,
+                   help="fraction of free HBM given to the KV page pool")
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--dtype", default=None,
+                   help="serving dtype override (bfloat16/float32; float16 "
+                   "maps to bfloat16 on TPU)")
+    p.add_argument("--enforce-eager", action="store_true",
+                   help="disable jit compile caching (debug; always slower)")
+    p.add_argument("--trust-remote-code", action="store_true",
+                   help="accepted for reference-values parity; local "
+                   "checkpoints never execute remote code here")
+    p.add_argument("--disable-custom-all-reduce", action="store_true",
+                   help="accepted for reference-values parity; XLA ICI "
+                   "collectives have no custom-allreduce path to disable")
     p.add_argument("--distributed", action="store_true",
                    help="call jax.distributed initialize (multi-host pods; "
                    "coordinator from KGCT_COORDINATOR, see parallel/mesh.py)")
@@ -296,13 +322,32 @@ def main(argv: Optional[list[str]] = None) -> None:
 
     if args.distributed:
         initialize_distributed()
-    config = EngineConfig(model=get_model_config(args.model),
-                          max_model_len=args.max_model_len)
+    model_cfg = get_model_config(args.model)
+    if args.dtype:
+        dtype = {"float16": "bfloat16", "half": "bfloat16",
+                 "bf16": "bfloat16"}.get(args.dtype, args.dtype)
+        model_cfg = model_cfg.replace(dtype=dtype)
+    if args.trust_remote_code or args.disable_custom_all_reduce:
+        logger.info("GPU-parity flags accepted and ignored "
+                    "(--trust-remote-code / --disable-custom-all-reduce)")
+    from ..config import SchedulerConfig
+    config = EngineConfig(
+        model=model_cfg,
+        cache=CacheConfig(hbm_utilization=args.hbm_utilization),
+        scheduler=SchedulerConfig(max_num_seqs=args.max_num_seqs),
+        parallel=ParallelConfig(tp=args.tensor_parallel_size,
+                                pp=args.pipeline_parallel_size),
+        max_model_len=args.max_model_len,
+        enforce_eager=args.enforce_eager)
+    mesh = None
+    if config.parallel.world_size > 1:
+        mesh = make_mesh(tp=config.parallel.tp, pp=config.parallel.pp)
     params = None
     if args.weights:
         from ..engine.weights import load_weights
         params = load_weights(args.weights, config.model)
-    server = build_server(config, args.tokenizer, args.model, params=params)
+    server = build_server(config, args.tokenizer, args.model, params=params,
+                          mesh=mesh)
     web.run_app(server.build_app(), host=args.host, port=args.port)
 
 

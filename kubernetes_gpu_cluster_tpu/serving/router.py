@@ -15,7 +15,8 @@ equivalent: an aiohttp reverse proxy that
 - streams responses through unbuffered (SSE passthrough).
 
 In-cluster, replica discovery is the headless-Service DNS name; static URLs
-work for local/dev. Deployment manifests are rendered by cluster/chart.
+work for local/dev. Deployment manifests are rendered by
+kubernetes_gpu_cluster_tpu.deploy (router Deployment + kgct-router-service).
 """
 
 from __future__ import annotations

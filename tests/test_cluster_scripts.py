@@ -1,0 +1,167 @@
+"""Cluster bootstrap scripts: syntax + DRY_RUN golden-output tests.
+
+The reference's bash was completely untested (SURVEY §4: "no automated
+tests"); its failure modes were discovered on real machines and journaled.
+These tests run every script in DRY_RUN mode (all state-changing commands go
+through run() and print ``DRY: ...``) and assert the load-bearing behaviors
+the reference got wrong first (reset ordering, the NO_PROXY cluster-CIDR
+fix from old_README.md:659-684, the --cri-socket join append from
+k8s_setup.sh:41-44) never regress.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+
+SCRIPTS = pathlib.Path(__file__).resolve().parent.parent / "cluster" / "scripts"
+ALL = sorted(SCRIPTS.glob("*.sh"))
+
+
+def run_script(script: str, *args: str, env: dict | None = None) -> str:
+    full_env = {"PATH": "/usr/bin:/bin:/usr/sbin:/sbin", "DRY_RUN": "1",
+                "HOME": "/tmp", **(env or {})}
+    r = subprocess.run(["bash", str(SCRIPTS / script), *args],
+                       capture_output=True, text=True, env=full_env,
+                       timeout=60)
+    assert r.returncode == 0, f"{script} rc={r.returncode}\n{r.stderr}"
+    return r.stdout + r.stderr
+
+
+@pytest.mark.parametrize("script", ALL, ids=lambda p: p.name)
+def test_bash_syntax(script):
+    r = subprocess.run(["bash", "-n", str(script)], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.parametrize("script", ALL, ids=lambda p: p.name)
+def test_shellcheck(script):
+    if shutil.which("shellcheck") is None:
+        pytest.skip("shellcheck not installed")
+    r = subprocess.run(["shellcheck", "-S", "error", str(script)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout
+
+
+def test_node_setup_teardown_order():
+    """Reset-first: kubelet stops and 6443 clears BEFORE state dirs vanish,
+    and teardown runs before any install step (reference main() ordering,
+    k8s_setup.sh:375-392)."""
+    out = run_script("tpu_node_setup.sh", "--role=control_plane", "--yes")
+    stop = out.index("DRY: systemctl stop kubelet")
+    rm = out.index("DRY: rm -rf /etc/kubernetes")
+    init = out.index("DRY: kubeadm init")
+    assert stop < rm < init
+    assert out.index("DRY: swapoff") < init
+    assert "DRY: kubeadm reset -f" in out
+
+
+def test_node_setup_no_proxy_covers_cluster_cidrs():
+    """The hard-won fix: NO_PROXY must include pod AND service CIDRs or
+    in-cluster traffic is sent to the egress proxy (old_README.md:659-684)."""
+    out = run_script(
+        "tpu_node_setup.sh", "--role=control_plane", "--yes",
+        env={"HTTP_PROXY_URL": "http://127.0.0.1:8118",
+             "POD_CIDR": "10.244.0.0/16", "SERVICE_CIDR": "10.96.0.0/12"})
+    no_proxy = [l for l in out.splitlines() if "NO_PROXY=" in l]
+    assert no_proxy, out
+    line = no_proxy[0]
+    for needed in ("10.244.0.0/16", "10.96.0.0/12", ".svc", ".cluster.local",
+                   "localhost"):
+        assert needed in line, f"NO_PROXY missing {needed}: {line}"
+
+
+def test_node_setup_join_appends_cri_socket():
+    """--join without --cri-socket gets the socket appended
+    (reference k8s_setup.sh:41-44)."""
+    out = run_script(
+        "tpu_node_setup.sh", "--role=node", "--yes",
+        "--join=kubeadm join 10.0.0.1:6443 --token abc --discovery-token-ca-cert-hash sha256:xyz")
+    join = [l for l in out.splitlines() if "kubeadm join" in l and "DRY" in l]
+    assert join, out
+    assert "--cri-socket=unix:///run/containerd/containerd.sock" in join[0]
+
+
+def test_node_setup_applies_cni_and_device_plugin_path():
+    """Control-plane flow applies the pinned CNI and points at the device
+    plugin manifest that actually exists in this repo."""
+    out = run_script("tpu_node_setup.sh", "--role=control_plane", "--yes")
+    assert "DRY: kubectl apply -f https://raw.githubusercontent.com/projectcalico/calico/v3.28.0/manifests/calico.yaml" in out
+    assert "DRY: wait for node Ready" in out
+    manifest = "cluster/device-plugin/manifest/daemonset.yaml"
+    assert manifest in out
+    assert (SCRIPTS.parent.parent / manifest).exists(), (
+        "script references a manifest path that does not exist")
+
+
+def test_node_setup_cni_gate():
+    out = run_script("tpu_node_setup.sh", "--role=control_plane", "--yes",
+                     env={"APPLY_CNI": "0"})
+    assert "skipping CNI" in out
+    assert "calico.yaml" not in out.replace("skipping CNI", "")
+
+
+def test_smoke_check_dry_lists_all_rows():
+    """DRY_RUN smoke_check prints every check row from SURVEY §4's table."""
+    out = run_script("smoke_check.sh")
+    for marker in ("curl --proxy", "systemctl is-active containerd",
+                   "sport = :6443", "kubectl get nodes -> all Ready",
+                   "google\\.com/tpu", "grep registered",
+                   "TPU acceptance pod (google.com/tpu: 1)",
+                   "kgct-router-service /health"):
+        assert marker in out, f"missing smoke row: {marker}\n{out}"
+
+
+def test_smoke_check_selects_single_row():
+    out = run_script("smoke_check.sh", "runtime")
+    assert "systemctl is-active containerd" in out
+    assert "TPU acceptance" not in out
+
+
+def test_runtime_setup_dry():
+    out = run_script("runtime_setup.sh")
+    assert "DRY" in out
+
+
+def test_proxy_setup_dry():
+    out = run_script("proxy_setup.sh", "--mode=ssh")
+    assert "DRY" in out
+
+
+def test_ha_setup_renders_configs():
+    """HA recipe renders the reference's keepalived/haproxy design
+    (multi-cp.md:196-291) from flags: one haproxy backend per control plane
+    with TLS healthz checks, VRRP instance tracking the apiserver."""
+    out = run_script(
+        "ha_setup.sh", "--vip=10.0.0.250",
+        "--cp-ips=10.0.0.1,10.0.0.2,10.0.0.3", "--interface=ens3",
+        "--state=MASTER", "--priority=101",
+        env={"AUTH_PASS": "testpass"})
+    # haproxy: one server line per CP, healthz check, round robin
+    for i, ip in enumerate(["10.0.0.1", "10.0.0.2", "10.0.0.3"], 1):
+        assert f"server cp{i} {ip}:6443 check verify none" in out
+    assert "http-check send meth GET uri /healthz" in out
+    assert "balance roundrobin" in out
+    assert "bind *:8443" in out                     # co-located LB port
+    # keepalived: VRRP on the right interface/priority, tracked healthz
+    assert "interface ens3" in out
+    assert "priority 101" in out
+    assert "state MASTER" in out
+    assert "10.0.0.250" in out
+    assert "check_apiserver" in out
+    assert "https://localhost:6443/healthz" in out
+    # operator handoff: the init one-liner through the VIP
+    assert "CONTROL_PLANE_ENDPOINT=10.0.0.250:8443" in out
+
+
+def test_ha_setup_requires_flags():
+    r = subprocess.run(
+        ["bash", str(SCRIPTS / "ha_setup.sh")],
+        capture_output=True, text=True,
+        env={"PATH": "/usr/bin:/bin", "DRY_RUN": "1"})
+    assert r.returncode == 1
+    assert "--vip" in r.stderr

@@ -101,10 +101,16 @@ def harness(plugin_bin, pb, tmp_path):
     plugdir.mkdir()
 
     kubelet = FakeKubelet(pb, str(plugdir))
-    proc = subprocess.Popen(
-        [str(plugin_bin), f"--plugin-dir={plugdir}", f"--dev-root={devdir}",
-         "--health-interval-s=1"],
-        stderr=subprocess.PIPE, text=True)
+    # stderr goes to a file, not a PIPE: reading a PIPE from a still-running
+    # process blocks forever (and an undrained PIPE would wedge the plugin
+    # after 64KB of logs).
+    errpath = tmp_path / "plugin.stderr"
+    with open(errpath, "w") as errf:
+        proc = subprocess.Popen(
+            [str(plugin_bin), f"--plugin-dir={plugdir}",
+             f"--dev-root={devdir}", "--health-interval-s=1"],
+            stderr=errf, text=True)
+    proc.errpath = errpath
     try:
         yield pb, devdir, plugdir, kubelet, proc
     finally:
@@ -124,7 +130,7 @@ def _channel(plugdir):
 def test_registers_with_kubelet(harness):
     pb, _, _, kubelet, proc = harness
     assert kubelet.event.wait(timeout=15), (
-        "plugin did not register; stderr:\n" + proc.stderr.read())
+        "plugin did not register; stderr:\n" + proc.errpath.read_text())
     req = kubelet.requests[0]
     assert req.version == "v1beta1"
     assert req.endpoint == "kgct-tpu.sock"

@@ -1,0 +1,113 @@
+"""Multi-host bootstrap test: 2 real OS processes over jax.distributed.
+
+SURVEY §4's explicit gap: the reference could only test multi-node on real
+machines (real IPs in multi-cp.md). Here two localhost CPU processes
+bootstrap through the same K8s-style env contract the deploy renderer
+injects into StatefulSet pods (KGCT_COORDINATOR / KGCT_NUM_PROCESSES /
+KGCT_PROCESS_ID — parallel/mesh.initialize_distributed), build a global
+2-device mesh, and run a psum + a sharded matmul across the process
+boundary. This is the jax.distributed replacement for the reference's
+Ray/KubeRay layer (old_README.md:1570-1625), tested without a cluster.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = r"""
+import os, sys
+import numpy as np
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["KGCT_REPO"])
+from kubernetes_gpu_cluster_tpu.parallel import initialize_distributed, make_mesh
+
+initialize_distributed()   # reads KGCT_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 2, jax.device_count()
+assert jax.local_device_count() == 1
+
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+mesh = make_mesh(dp=2)
+
+# 1) cross-process psum: each rank contributes (rank+1); sum must be 3.
+@jax.jit
+def allreduce(x):
+    return shard_map(lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+                     in_specs=P("dp"), out_specs=P())(x)
+
+rank = jax.process_index()
+local = np.full((1, 4), rank + 1, np.float32)
+garr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")), local, (2, 4))
+out = allreduce(garr)
+total = np.asarray(multihost_utils.process_allgather(out, tiled=True))
+assert np.all(total == 3.0), total
+
+# 2) dp-sharded matmul with a replicated weight (the engine's DP layout).
+w = jnp.arange(12, dtype=jnp.float32).reshape(4, 3)
+@jax.jit
+def fwd(x, w):
+    return x @ w
+y = fwd(garr, w)
+expect = np.full((1, 3), 0, np.float32)
+y_local = np.asarray(y.addressable_shards[0].data)
+ref = local @ np.arange(12, dtype=np.float32).reshape(4, 3)
+assert np.allclose(y_local, ref), (y_local, ref)
+
+print(f"RANK{rank}-OK")
+"""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="localhost gloo test")
+def test_two_process_jax_distributed(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+
+    procs = []
+    for rank in (0, 1):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)       # exactly one local CPU device each
+        env.update({
+            "KGCT_REPO": repo,
+            "KGCT_COORDINATOR": f"127.0.0.1:{port}",
+            "KGCT_NUM_PROCESSES": "2",
+            "KGCT_PROCESS_ID": str(rank),
+            "JAX_NUM_CPU_DEVICES": "1",
+            "TPU_SKIP_MDS_QUERY": "1",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"rank {rank} failed:\n{err[-3000:]}"
+        assert f"RANK{rank}-OK" in out, (out, err[-1000:])

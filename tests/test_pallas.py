@@ -1,5 +1,12 @@
-"""Pallas kernels vs the XLA reference implementations (interpret mode on the
-CPU mesh; the same kernels compile on real TPU)."""
+"""Pallas kernels vs the XLA reference implementations — NUMERICS ONLY.
+
+These run in interpret mode on the CPU mesh and cannot catch Mosaic
+compile-time failures (round-2 postmortem). The on-chip compile gates are:
+benchmarks/tpu_kernel_check.py (manual, compile + numerics on the real
+chip), the engine's init-time probe compile with XLA fallback
+(engine.LLMEngine._probe_pallas_compile), and __graft_entry__.entry() which
+builds its step with use_pallas=True on TPU for the driver's compile check.
+"""
 
 import jax
 import jax.numpy as jnp
