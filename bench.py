@@ -38,11 +38,16 @@ from kubernetes_gpu_cluster_tpu.engine import LLMEngine, SamplingParams
 # Representative single-A100 vLLM decode throughput, ~1B-class model, batch 64.
 A100_VLLM_TOKS_PER_S = 6000.0
 
-BATCH = 64
-PROMPT_LEN = 128
-DECODE_WINDOW = 32          # substeps per XLA program; hides the host RT
+import os
+
+BATCH = int(os.environ.get("KGCT_BENCH_BATCH", 64))
+PROMPT_LEN = int(os.environ.get("KGCT_BENCH_PROMPT", 128))
+# Substeps per XLA program. Sized so device time per window (~3 ms/substep on
+# v5e) comfortably exceeds the host round trip (~110 ms on the tunnel-attached
+# chip) — the speculative window chain then fully hides the host.
+DECODE_WINDOW = int(os.environ.get("KGCT_BENCH_WINDOW", 64))
 WARMUP_WINDOWS = 3
-BENCH_WINDOWS = 16
+BENCH_WINDOWS = int(os.environ.get("KGCT_BENCH_WINDOWS", 12))
 MAX_NEW_TOKENS = PROMPT_LEN + DECODE_WINDOW * (WARMUP_WINDOWS + BENCH_WINDOWS + 4)
 
 

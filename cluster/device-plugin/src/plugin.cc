@@ -49,6 +49,7 @@ struct Options {
   std::string resource = "google.com/tpu";
   std::string dev_root = "/dev";
   std::string dev_prefix = "accel";
+  std::string cdi_spec_path;  // --write-cdi-spec=PATH: emit CDI json + exit
   int health_interval_s = 5;
   bool register_with_kubelet = true;
   bool oneshot = false;  // tests: exit after first ListAndWatch push + idle
@@ -136,6 +137,49 @@ std::map<std::string, std::string> ScanDevices(const Options& opt) {
   }
   closedir(d);
   return devices;
+}
+
+// -- CDI spec (C19 parity) --------------------------------------------------
+// The reference's GPU chain generated /etc/cdi/nvidia.yaml via nvidia-ctk
+// (reference gpu-crio-setup.sh:87-101) so CDI-mode runtimes could inject
+// devices without the prestart hook. TPU equivalent: enumerate the chips as
+// a CDI spec; CRI-O/containerd with CDI enabled can then inject them via
+// `cdi.k8s.io/google.com/tpu=<n>` annotations — an alternative to the
+// device-plugin Allocate path for non-k8s container runs.
+
+int WriteCdiSpec(const Options& opt, const std::string& path) {
+  auto devices = ScanDevices(opt);
+  FILE* f = fopen(path.c_str(), "w");
+  if (f == nullptr) {
+    fprintf(stderr, "[kgct-device-plugin] cannot write %s\n", path.c_str());
+    return 1;
+  }
+  fprintf(f, "{\n  \"cdiVersion\": \"0.6.0\",\n  \"kind\": \"%s\",\n"
+             "  \"devices\": [\n", opt.resource.c_str());
+  bool first = true;
+  for (const auto& [id, health] : devices) {
+    (void)health;
+    std::string idx = id.substr(opt.dev_prefix.size());
+    fprintf(f, "%s    {\n      \"name\": \"%s\",\n      \"containerEdits\": "
+               "{\n        \"deviceNodes\": [\n          {\"path\": "
+               "\"/dev/%s\", \"hostPath\": \"%s/%s\", \"permissions\": "
+               "\"rw\"}\n        ]\n      }\n    }",
+            first ? "" : ",\n", idx.c_str(), id.c_str(),
+            opt.dev_root.c_str(), id.c_str());
+    first = false;
+  }
+  fprintf(f, "\n  ],\n  \"containerEdits\": {}\n}\n");
+  // A truncated spec (ENOSPC etc.) must not report success — the runtime
+  // would silently stop injecting devices on a parse failure later.
+  bool write_err = ferror(f) != 0;
+  if (fclose(f) != 0 || write_err) {
+    fprintf(stderr, "[kgct-device-plugin] short write to %s\n", path.c_str());
+    ::unlink(path.c_str());
+    return 1;
+  }
+  fprintf(stderr, "[kgct-device-plugin] wrote CDI spec (%zu devices) to %s\n",
+          devices.size(), path.c_str());
+  return 0;
 }
 
 // -- plugin service ---------------------------------------------------------
@@ -303,6 +347,7 @@ int Main(int argc, char** argv) {
     else if (const char* v = val("--dev-prefix")) opt.dev_prefix = v;
     else if (const char* v = val("--health-interval-s"))
       opt.health_interval_s = atoi(v);
+    else if (const char* v = val("--write-cdi-spec")) opt.cdi_spec_path = v;
     else if (a == "--no-register") opt.register_with_kubelet = false;
     else if (a == "--oneshot") opt.oneshot = true;
     else {
@@ -310,10 +355,11 @@ int Main(int argc, char** argv) {
               "usage: kgct-tpu-device-plugin [--plugin-dir=DIR] "
               "[--endpoint=NAME.sock] [--resource=NAME] [--dev-root=DIR] "
               "[--dev-prefix=accel] [--health-interval-s=N] [--no-register] "
-              "[--oneshot]\n");
+              "[--oneshot] [--write-cdi-spec=/etc/cdi/kgct-tpu.json]\n");
       return a == "--help" ? 0 : 2;
     }
   }
+  if (!opt.cdi_spec_path.empty()) return WriteCdiSpec(opt, opt.cdi_spec_path);
   signal(SIGPIPE, SIG_IGN);
   signal(SIGTERM, OnSignal);
   signal(SIGINT, OnSignal);

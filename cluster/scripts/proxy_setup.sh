@@ -9,6 +9,8 @@
 #
 # Modes:
 #   --mode=ssh   SSH dynamic tunnel (needs TUNNEL_* env or /etc/kgct/tunnel.env)
+#   --mode=xray  Xray VLESS client -> SOCKS5 :1080 (needs XRAY_VLESS_URL or a
+#                prepared XRAY_CONFIG json; reference xray_setup.sh:50-91)
 #   --mode=none  write registry-mirror config only (default: air-gapped TPU
 #                clusters usually mirror images instead of proxying)
 #   --mode=privoxy-only  bridge an existing SOCKS5 at $SOCKS5_PORT to :8118
@@ -22,15 +24,20 @@ SOCKS5_PORT="${SOCKS5_PORT:-1111}"
 HTTP_PORT="${HTTP_PORT:-8118}"
 ENV_FILE="${ENV_FILE:-/etc/kgct/tunnel.env}"
 REGISTRY_MIRROR="${REGISTRY_MIRROR:-}"
+XRAY_VLESS_URL="${XRAY_VLESS_URL:-}"     # vless://uuid@host:port?...
+XRAY_CONFIG="${XRAY_CONFIG:-}"           # or: path to a prepared config.json
+XRAY_SOCKS_PORT="${XRAY_SOCKS_PORT:-1080}"
 DRY_RUN="${DRY_RUN:-0}"
 
 log() { echo -e "\e[32m[proxy]\e[0m $*"; }
 err() { echo -e "\e[31m[proxy]\e[0m $*" >&2; }
 run() { if [[ "$DRY_RUN" == "1" ]]; then echo "DRY: $*"; else "$@"; fi }
 
+RENDER_ONLY_URL=""
 for arg in "$@"; do
   case "$arg" in
     --mode=*) MODE="${arg#*=}" ;;
+    --render-xray-config=*) RENDER_ONLY_URL="${arg#*=}" ;;  # print json + exit
     *) err "unknown flag $arg"; exit 1 ;;
   esac
 done
@@ -67,6 +74,99 @@ WantedBy=multi-user.target
 EOF
   systemctl daemon-reload
   systemctl enable --now kgct-tunnel.service
+}
+
+setup_xray() {
+  # Xray VLESS client -> local SOCKS5 (reference xray_setup.sh:50-91 install +
+  # config fetch; hardened service unit per xray_reset.sh:114-137: root,
+  # Restart=always, NOFILE 65535)
+  log "installing Xray VLESS client (SOCKS5 :$XRAY_SOCKS_PORT)"
+  if [[ "$DRY_RUN" == "1" ]]; then
+    echo "DRY: install xray via official install-release.sh"
+    echo "DRY: render /usr/local/etc/xray/config.json (socks :$XRAY_SOCKS_PORT -> vless outbound)"
+    echo "DRY: systemd override Restart=always LimitNOFILE=65535"
+    return 0
+  fi
+  if ! command -v xray >/dev/null; then
+    bash -c "$(curl -L https://github.com/XTLS/Xray-install/raw/main/install-release.sh)" \
+      @ install || { err "xray install failed"; exit 1; }
+  fi
+  mkdir -p /usr/local/etc/xray
+  if [[ -n "$XRAY_CONFIG" ]]; then
+    cp "$XRAY_CONFIG" /usr/local/etc/xray/config.json
+  elif [[ -n "$XRAY_VLESS_URL" ]]; then
+    render_xray_config "$XRAY_VLESS_URL" > /usr/local/etc/xray/config.json
+  else
+    err "set XRAY_VLESS_URL or XRAY_CONFIG for --mode=xray"; exit 1
+  fi
+  mkdir -p /etc/systemd/system/xray.service.d
+  cat > /etc/systemd/system/xray.service.d/override.conf <<'EOF'
+[Service]
+User=root
+Restart=always
+RestartSec=3
+LimitNOFILE=65535
+EOF
+  systemctl daemon-reload
+  systemctl enable --now xray
+  systemctl restart xray
+  sleep 2
+  # socks5h: resolve THROUGH the proxy — local DNS is poisoned on exactly the
+  # networks this mode exists for
+  curl -fsS --max-time 15 --proxy "socks5h://127.0.0.1:$XRAY_SOCKS_PORT" \
+    https://ipinfo.io/ip >/dev/null \
+    || { err "xray SOCKS5 self-test failed"; exit 1; }
+  log "xray SOCKS5 up on :$XRAY_SOCKS_PORT"
+}
+
+render_xray_config() {
+  # vless://<uuid>@<host>:<port>?security=tls&type=ws&sni=...&path=...#name
+  # (the standard share-link shape) -> client config json. Unsupported
+  # security/type values fail loudly rather than degrading to plaintext.
+  local url="${1%%#*}"                      # strip #fragment
+  local body="${url#vless://}"
+  local uuid="${body%%@*}"
+  local rest="${body#*@}"
+  local hostport="${rest%%\?*}"
+  local query=""
+  [[ "$rest" == *\?* ]] && query="${rest#*\?}"
+  local host="${hostport%%:*}"
+  local port="${hostport##*:}"
+  local security="none" net="tcp" sni="" wspath="/"
+  local kv
+  IFS='&' read -ra kv <<< "$query"
+  for pair in "${kv[@]}"; do
+    case "$pair" in
+      security=*) security="${pair#*=}" ;;
+      type=*) net="${pair#*=}" ;;
+      sni=*) sni="${pair#*=}" ;;
+      path=*) wspath="${pair#*=}" ;;
+    esac
+  done
+  [[ "$port" =~ ^[0-9]+$ ]] || { err "bad port in VLESS url: $port"; exit 1; }
+  case "$security" in none|tls) ;; *)
+    err "unsupported VLESS security=$security (none|tls)"; exit 1 ;; esac
+  case "$net" in tcp|ws) ;; *)
+    err "unsupported VLESS type=$net (tcp|ws)"; exit 1 ;; esac
+  local stream="\"network\": \"$net\", \"security\": \"$security\""
+  [[ "$security" == "tls" ]] && \
+    stream="$stream, \"tlsSettings\": {\"serverName\": \"${sni:-$host}\"}"
+  [[ "$net" == "ws" ]] && \
+    stream="$stream, \"wsSettings\": {\"path\": \"$wspath\"}"
+  cat <<EOF
+{
+  "inbounds": [{
+    "listen": "127.0.0.1", "port": $XRAY_SOCKS_PORT, "protocol": "socks",
+    "settings": {"udp": true}
+  }],
+  "outbounds": [{
+    "protocol": "vless",
+    "settings": {"vnext": [{"address": "$host", "port": $port,
+      "users": [{"id": "$uuid", "encryption": "none"}]}]},
+    "streamSettings": {$stream}
+  }]
+}
+EOF
 }
 
 setup_privoxy() {
@@ -113,11 +213,16 @@ self_test() {  # reference privoxy_setup.sh:32-38
 }
 
 main() {
+  if [[ -n "$RENDER_ONLY_URL" ]]; then   # config-render debug/test entry
+    render_xray_config "$RENDER_ONLY_URL"
+    exit 0
+  fi
   case "$MODE" in
     ssh) setup_ssh_tunnel; setup_privoxy ;;
+    xray) SOCKS5_PORT="$XRAY_SOCKS_PORT"; setup_xray; setup_privoxy ;;
     privoxy-only) setup_privoxy ;;
     none) ;;
-    *) err "unknown --mode=$MODE (ssh|privoxy-only|none)"; exit 1 ;;
+    *) err "unknown --mode=$MODE (ssh|xray|privoxy-only|none)"; exit 1 ;;
   esac
   setup_registry_mirror
   self_test

@@ -81,6 +81,30 @@ image-endpoint: $sock
 EOF
 }
 
+install_crun_from_source() {
+  # CRI-O parity for the reference's deepest runtime fix: distro crun broke
+  # containers ("unknown version specified", reference old_README.md:1184-1199),
+  # so v1.21 was compiled from C source (reference gpu-crio-setup.sh:43-56).
+  # Gated: only needed with --runtime=crio when the packaged crun misbehaves.
+  [[ "${BUILD_CRUN:-0}" != "1" ]] && return 0
+  local ver="${CRUN_VERSION:-1.21}"
+  log "building crun $ver from source"
+  if [[ "$DRY_RUN" == "1" ]]; then
+    echo "DRY: apt install build deps (autoconf libtool libcap-dev libseccomp-dev libyajl-dev)"
+    echo "DRY: git clone --branch $ver https://github.com/containers/crun && autogen/configure/make install"
+    return 0
+  fi
+  apt_proxied install -y make gcc git autoconf automake libtool pkg-config \
+    python3 libcap-dev libseccomp-dev libyajl-dev go-md2man
+  local src=/usr/local/src/crun
+  rm -rf "$src"
+  git clone --depth 1 --branch "$ver" https://github.com/containers/crun "$src"
+  (cd "$src" && ./autogen.sh && ./configure && make -j"$(nproc)" \
+    && make install)
+  log "crun installed: $(/usr/local/bin/crun --version | head -1)"
+  log "apply cluster/manifests/runtimeclass-crun.yaml and set runtimeClassName"
+}
+
 verify() {  # smoke checks (reference crio_setup.sh:69-70, README.md:49)
   log "verify:"
   run systemctl is-active "$RUNTIME" || true
@@ -93,6 +117,7 @@ main() {
     crio) install_crio ;;
     *) err "unknown --runtime=$RUNTIME"; exit 1 ;;
   esac
+  install_crun_from_source
   install_crictl
   verify
 }

@@ -265,9 +265,26 @@ init_control_plane() {
     label_node "$(hostname | tr '[:upper:]' '[:lower:]')" || true
   fi
   apply_cni
+  fix_coredns
   log "control plane up. Next:"
   log "  kubectl apply -f cluster/device-plugin/manifest/daemonset.yaml"
   log "  bash cluster/scripts/smoke_check.sh   # automated acceptance checks"
+}
+
+fix_coredns() {  # C30-class cluster hardening (reference old_README.md:780-850:
+                 # CoreDNS health port clash + GODEBUG): move the health probe
+                 # off :8181 when the host already binds it. Gated, optional.
+  [[ "${FIX_COREDNS:-0}" != "1" ]] && return 0
+  log "patching CoreDNS health port 8181 -> 8182 (reference failure mode)"
+  if [[ "$DRY_RUN" == "1" ]]; then
+    echo "DRY: kubectl -n kube-system patch configmap coredns (health :8182)"
+    echo "DRY: kubectl -n kube-system rollout restart deployment coredns"
+    return 0
+  fi
+  kubectl -n kube-system get configmap coredns -o yaml \
+    | sed 's/health {/health :8182 {/; s/^\(\s*\)health$/\1health :8182/' \
+    | kubectl apply -f -
+  kubectl -n kube-system rollout restart deployment coredns
 }
 
 apply_cni() {  # pinned CNI + node-Ready gate (reference README.md:78,

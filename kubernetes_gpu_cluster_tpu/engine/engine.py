@@ -113,7 +113,13 @@ class LLMEngine:
                                           kv_sharding)
 
         self._prefill_fn = self._build_prefill_fn()
-        self._decode_fn = self._build_decode_fn()
+        # Two compiled window programs: all-greedy batches (the common
+        # serving case) never trace sampling at all — argmax only. Selection
+        # happens HOST-side per batch from its SamplingParams; a runtime
+        # lax.cond inside the scan would keep the sampling subgraph in the
+        # program and its cost on the critical path.
+        self._decode_fn = self._build_decode_fn(greedy=False)
+        self._decode_fn_greedy = self._build_decode_fn(greedy=True)
         self.stats = EngineStats()
         self.step_count = 0
         # Speculative decode-window chain state (see step()).
@@ -212,14 +218,16 @@ class LLMEngine:
 
         return self._maybe_jit(prefill_step, donate_argnums=(1,))
 
-    def _build_decode_fn(self):
+    def _build_decode_fn(self, greedy: bool = False):
         """Multi-step decode: W autoregressive steps inside one XLA program.
         Sampled tokens feed back on-device through a lax.scan; per-sub-step
         positions/slots/context-lens are recomputed from the page tables, so
         only one host->device upload and one [B, W] download happen per
         window. This is what keeps continuous batching fast when the host
         round-trip is the bottleneck (and it always is: TPU decode steps are
-        ~ms, host syncs are not free anywhere)."""
+        ~ms, host syncs are not free anywhere).
+
+        ``greedy=True`` compiles the argmax-only variant (see __init__)."""
         cfg = self.model_config
         use_pallas = self.use_pallas
         W = self.config.scheduler.decode_window
@@ -258,8 +266,12 @@ class LLMEngine:
                 hidden, kv, _ = model_lib.forward_decode(
                     params, cfg, tokens, m, kv, use_pallas=use_pallas)
                 logits = model_lib.compute_logits(params, cfg, hidden)
-                next_tokens = sample_tokens(logits, jax.random.fold_in(key, i),
-                                            temperature, top_k, top_p)
+                if greedy:
+                    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    next_tokens = sample_tokens(
+                        logits, jax.random.fold_in(key, i),
+                        temperature, top_k, top_p)
                 return (kv, next_tokens, pos + 1), next_tokens
 
             (kv, _, _), toks = jax.lax.scan(
@@ -371,7 +383,9 @@ class LLMEngine:
             [np.stack([positions, batch.top_k], axis=1), batch.page_tables],
             axis=1))
         self._key, step_key = jax.random.split(self._key)
-        dev_out, self.kv_cache = self._decode_fn(
+        fn = (self._decode_fn_greedy if bool(np.all(batch.temperature <= 0))
+              else self._decode_fn)
+        dev_out, self.kv_cache = fn(
             self.params, self.kv_cache, tokens_dev, int_b, float_b, step_key)
         return {"batch": batch, "dev_out": dev_out, "positions": positions,
                 "float_b": float_b, "zombies": set()}

@@ -213,15 +213,21 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array, kv: KVCache,
                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Scan the layer body over stacked weights.
 
-    The KV pool enters the scan READ-ONLY (sliced per layer as xs); each
-    layer's freshly projected K/V come out as scan ys, and the caller commits
-    them to the pool in ONE donated scatter after the scan
-    (ops.attention.write_kv_pages_all). Threading the pool through the scan
-    as carry/ys would force XLA to copy the whole pool every step.
+    The KV pool does NOT travel through the scan: it is closed over whole and
+    ``attn_fn`` receives the LAYER INDEX (scanned as xs) to address it.
+    Slicing the pool per layer as scan xs — the previous design — made XLA
+    materialize a [1, P, ps, kd] copy of each layer's pool every layer every
+    substep (~1.4 ms/substep, ~20% of decode, measured in the round-3 device
+    trace); the Pallas kernel addresses the stacked pool with a dynamic layer
+    index instead, moving zero pool bytes. Each layer's freshly projected
+    K/V come out as scan ys, and the caller commits them to the pool in ONE
+    donated scatter after the scan (ops.attention.write_kv_pages_all).
+    Threading the pool through the scan as carry/ys would force a full pool
+    copy per step.
 
-    attn_fn(lp, q, k, v, k_pool_l, v_pool_l) -> attn_out, where the pool
-    slices hold tokens written in PREVIOUS steps only (attention folds the
-    current step's k/v in directly).
+    attn_fn(lp, q, k, v, layer_idx) -> attn_out, where the pool holds tokens
+    written in PREVIOUS steps only (attention folds the current step's k/v in
+    directly).
 
     ``layer_slice`` restricts to a contiguous [start, stop) layer range.
     ``tp_axis``/``ep_axis`` name manual mesh axes when running inside
@@ -234,14 +240,13 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array, kv: KVCache,
     if layer_slice is not None:
         start, stop = layer_slice
         layers = jax.tree.map(lambda a: a[start:stop], layers)
-        kv = KVCache(k=kv.k[start:stop], v=kv.v[start:stop])
 
     def body(h, xs):
-        lp, k_pool, v_pool = xs
+        lp, layer_idx = xs
         resid = h
         x = rms_norm(h, lp["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, x, positions)
-        attn_out = attn_fn(lp, q, k, v, k_pool, v_pool)
+        attn_out = attn_fn(lp, q, k, v, layer_idx)
         attn_out = attn_out.reshape(x.shape[0], -1)
         o = jnp.dot(attn_out, lp["wo"], preferred_element_type=jnp.float32)
         if tp_axis is not None:  # row-sharded wo: partial sums over local heads
@@ -252,7 +257,9 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array, kv: KVCache,
         h = resid + _mlp_block(lp, cfg, x, tp_axis=tp_axis, ep_axis=ep_axis)
         return h, (k, v)
 
-    h, (k_all, v_all) = jax.lax.scan(body, h, (layers, kv.k, kv.v))
+    n_layers = jax.tree.leaves(layers)[0].shape[0]
+    h, (k_all, v_all) = jax.lax.scan(
+        body, h, (layers, jnp.arange(n_layers, dtype=jnp.int32)))
     return h, k_all, v_all
 
 
@@ -268,7 +275,7 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
     scale = cfg.head_dim ** -0.5
     h = params["embed"][tokens] if hidden_in is None else hidden_in
 
-    def attn_fn(lp, q, k, v, k_pool, v_pool):
+    def attn_fn(lp, q, k, v, layer_idx):
         # Prefill attends within the in-batch k/v only (each sequence's whole
         # prompt is in this batch); the pool is written post-scan for decode.
         return ragged_prefill_attention(q, k, v, meta.seg_ids, meta.positions,
@@ -296,18 +303,21 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
     scale = cfg.head_dim ** -0.5
     h = params["embed"][tokens] if hidden_in is None else hidden_in
 
-    def attn_fn(lp, q, k, v, k_pool, v_pool):
-        # Pool holds positions 0..ctx-2; this step's k/v fold in directly and
-        # are committed to the pool in one post-scan scatter.
-        return paged_decode_attention(q, k_pool, v_pool, meta.page_tables,
-                                      meta.context_lens, k, v, scale,
-                                      use_pallas=use_pallas)
-
-    h, k_all, v_all = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn,
-                                  layer_slice, tp_axis=tp_axis, ep_axis=ep_axis)
     if layer_slice is not None:
         kv = KVCache(k=kv.k[layer_slice[0]:layer_slice[1]],
                      v=kv.v[layer_slice[0]:layer_slice[1]])
+
+    def attn_fn(lp, q, k, v, layer_idx):
+        # Pool holds positions 0..ctx-2; this step's k/v fold in directly and
+        # are committed to the pool in one post-scan scatter. The STACKED pool
+        # + dynamic layer index go straight to the kernel — no per-layer pool
+        # slice is ever materialized (see _layer_scan docstring).
+        return paged_decode_attention(q, kv.k, kv.v, meta.page_tables,
+                                      meta.context_lens, k, v, scale,
+                                      layer=layer_idx, use_pallas=use_pallas)
+
+    h, k_all, v_all = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn,
+                                  layer_slice, tp_axis=tp_axis, ep_axis=ep_axis)
     new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
                                          meta.slot_mapping))
     return rms_norm(h, params["final_norm"], cfg.rms_norm_eps), new_kv, h

@@ -19,6 +19,7 @@ that select the Pallas TPU kernels from ``ops.pallas`` when running on TPU.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -134,6 +135,7 @@ def paged_decode_attention_xla(
     k_cur: jax.Array,        # [B, n_kv, hd] current token's K (not yet in pool)
     v_cur: jax.Array,        # [B, n_kv, hd] current token's V
     scale: float,
+    layer: Optional[jax.Array] = None,  # with a stacked [L, ...] pool
 ) -> jax.Array:
     """Gather-then-attend reference implementation.
 
@@ -143,6 +145,11 @@ def paged_decode_attention_xla(
     worth of K/V — HBM-bandwidth-bound, which is what the Pallas kernel
     (pallas_paged_decode) avoids by streaming only valid pages through VMEM
     with online softmax."""
+    if layer is not None and k_cache_l.ndim == 4:
+        k_cache_l = jax.lax.dynamic_index_in_dim(k_cache_l, layer, 0,
+                                                 keepdims=False)
+        v_cache_l = jax.lax.dynamic_index_in_dim(v_cache_l, layer, 0,
+                                                 keepdims=False)
     B, n_heads, hd = q.shape
     P, ps, _ = k_cache_l.shape
     n_kv = k_cur.shape[1]
@@ -183,15 +190,21 @@ def ragged_prefill_attention(q, k, v, seg_ids, positions, scale, *, use_pallas=N
 
 
 def paged_decode_attention(q, k_cache_l, v_cache_l, page_tables, context_lens,
-                           k_cur, v_cur, scale, *, use_pallas=None):
+                           k_cur, v_cur, scale, *, layer=None,
+                           use_pallas=None):
+    """``layer`` (with a stacked [L, P, ps, n_kv*hd] pool) lets the Pallas
+    kernel address the pool with a dynamic layer index instead of the caller
+    slicing a per-layer copy out — the zero-copy path the decode scan uses."""
     if use_pallas is None:
         use_pallas = _on_tpu()
     if use_pallas:
         try:
             from .pallas.paged_decode import pallas_paged_decode
             return pallas_paged_decode(q, k_cache_l, v_cache_l, page_tables,
-                                       context_lens, k_cur, v_cur, scale)
+                                       context_lens, k_cur, v_cur, scale,
+                                       layer=layer)
         except Exception as e:  # pragma: no cover - fallback safety
             logger.warning("pallas decode unavailable (%s); falling back to XLA", e)
     return paged_decode_attention_xla(q, k_cache_l, v_cache_l, page_tables,
-                                      context_lens, k_cur, v_cur, scale)
+                                      context_lens, k_cur, v_cur, scale,
+                                      layer=layer)

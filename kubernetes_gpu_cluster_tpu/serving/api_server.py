@@ -57,10 +57,12 @@ def _stops(body: dict) -> list[str]:
 class APIServer:
     def __init__(self, engine: AsyncLLMEngine, tokenizer: Tokenizer,
                  model_name: str):
+        import asyncio
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.metrics = Metrics(engine.engine)
+        self._profile_lock = asyncio.Lock()
 
     # -- app wiring ----------------------------------------------------------
 
@@ -71,6 +73,7 @@ class APIServer:
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.prometheus)
+        app.router.add_post("/debug/profile", self.profile)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -93,6 +96,35 @@ class APIServer:
     async def prometheus(self, request: web.Request) -> web.Response:
         return web.Response(text=self.metrics.render(),
                             content_type="text/plain")
+
+    async def profile(self, request: web.Request) -> web.Response:
+        """Capture a jax.profiler trace of live serving traffic.
+
+        ``POST /debug/profile?seconds=3`` blocks for the window and returns
+        the trace directory (under /tmp/kgct-profile; open with
+        xprof/tensorboard). One capture at a time — concurrent requests get
+        409 rather than clobbering the active trace. The observability the
+        reference lacked entirely (SURVEY §5 "Tracing/profiling: none")."""
+        import asyncio
+
+        import jax
+
+        if self._profile_lock.locked():
+            return _error(409, "a profile capture is already running")
+        async with self._profile_lock:
+            seconds = float(request.query.get("seconds", 3))
+            seconds = min(max(seconds, 0.1), 60.0)
+            trace_dir = "/tmp/kgct-profile"
+            try:
+                jax.profiler.start_trace(trace_dir)
+                await asyncio.sleep(seconds)
+            finally:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:
+                    return _error(500, f"profiler stop failed: {e}")
+        return web.json_response({"trace_dir": trace_dir,
+                                  "seconds": seconds})
 
     async def models(self, request: web.Request) -> web.Response:
         return web.json_response({

@@ -165,3 +165,61 @@ def test_ha_setup_requires_flags():
         env={"PATH": "/usr/bin:/bin", "DRY_RUN": "1"})
     assert r.returncode == 1
     assert "--vip" in r.stderr
+
+
+def test_proxy_setup_xray_mode_dry():
+    """Xray VLESS egress provider (reference xray_setup.sh/xray_reset.sh):
+    install + config render + hardened unit, all behind DRY_RUN."""
+    out = run_script("proxy_setup.sh", "--mode=xray",
+                     env={"XRAY_VLESS_URL": "vless://u-u-i-d@vpn.example.com:443"})
+    assert "install xray via official install-release.sh" in out
+    assert "socks :1080 -> vless outbound" in out
+    assert "Restart=always LimitNOFILE=65535" in out
+    assert "apt install privoxy" in out          # bridged to :8118
+
+
+def test_runtime_setup_crun_build_gated():
+    """BUILD_CRUN=1 compiles crun from source (reference
+    gpu-crio-setup.sh:43-56); off by default."""
+    out = run_script("runtime_setup.sh", env={"BUILD_CRUN": "1"})
+    assert "git clone --branch 1.21 https://github.com/containers/crun" in out
+    out_default = run_script("runtime_setup.sh")
+    assert "crun" not in out_default
+
+
+def test_node_setup_coredns_fix_gated():
+    out = run_script("tpu_node_setup.sh", "--role=control_plane", "--yes",
+                     env={"FIX_COREDNS": "1"})
+    assert "patch configmap coredns" in out
+    out_default = run_script("tpu_node_setup.sh", "--role=control_plane",
+                             "--yes")
+    assert "coredns" not in out_default
+
+
+def test_proxy_setup_xray_url_parsing():
+    """Share-link shaped VLESS URLs (#fragment, tls/ws params) must not
+    produce broken or plaintext configs; unsupported types fail loudly."""
+    import json
+    r = subprocess.run(
+        ["bash", str(SCRIPTS / "proxy_setup.sh"),
+         "--render-xray-config=vless://uid-1@vpn.example.com:443"
+         "?security=tls&type=ws&sni=cdn.example.com&path=/ray#my server"],
+        capture_output=True, text=True,
+        env={"PATH": "/usr/bin:/bin", "DRY_RUN": "1"})
+    assert r.returncode == 0, r.stderr
+    cfg = json.loads(r.stdout)
+    out = cfg["outbounds"][0]
+    assert out["settings"]["vnext"][0]["port"] == 443      # fragment stripped
+    ss = out["streamSettings"]
+    assert ss["security"] == "tls"
+    assert ss["tlsSettings"]["serverName"] == "cdn.example.com"
+    assert ss["network"] == "ws"
+    assert ss["wsSettings"]["path"] == "/ray"
+
+    r2 = subprocess.run(
+        ["bash", str(SCRIPTS / "proxy_setup.sh"),
+         "--render-xray-config=vless://uid@h:443?security=reality"],
+        capture_output=True, text=True,
+        env={"PATH": "/usr/bin:/bin", "DRY_RUN": "1"})
+    assert r2.returncode != 0
+    assert "unsupported" in r2.stderr

@@ -216,3 +216,26 @@ def test_allocate_unknown_device_fails(harness):
         )(req, timeout=10)
     assert e.value.code() == grpc.StatusCode.NOT_FOUND
     ch.close()
+
+
+def test_write_cdi_spec(plugin_bin, tmp_path):
+    """--write-cdi-spec emits a valid CDI json for the chips (C19 parity:
+    the reference generated /etc/cdi/nvidia.yaml via nvidia-ctk,
+    gpu-crio-setup.sh:87-101)."""
+    import json
+    devdir = tmp_path / "dev"
+    devdir.mkdir()
+    for i in range(2):
+        (devdir / f"accel{i}").touch()
+    spec_path = tmp_path / "kgct-tpu.json"
+    r = subprocess.run(
+        [str(plugin_bin), f"--dev-root={devdir}",
+         f"--write-cdi-spec={spec_path}"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    spec = json.loads(spec_path.read_text())
+    assert spec["kind"] == "google.com/tpu"
+    assert [d["name"] for d in spec["devices"]] == ["0", "1"]
+    nodes = spec["devices"][1]["containerEdits"]["deviceNodes"][0]
+    assert nodes["path"] == "/dev/accel1"
+    assert nodes["hostPath"] == f"{devdir}/accel1"
