@@ -64,9 +64,10 @@ def main() -> None:
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     model_name = "tinyllama-1.1b" if on_tpu else "debug-tiny"
+    quant = os.environ.get("KGCT_BENCH_QUANT") or None
     pages_per_seq = (PROMPT_LEN + MAX_NEW_TOKENS) // 16 + 3
     cfg = EngineConfig(
-        model=get_model_config(model_name),
+        model=get_model_config(model_name).replace(quantization=quant),
         cache=CacheConfig(page_size=16, num_pages=BATCH * pages_per_seq + 1),
         scheduler=SchedulerConfig(
             max_num_seqs=BATCH, max_prefill_tokens=2048,

@@ -133,14 +133,18 @@ render_xray_config() {
   local host="${hostport%%:*}"
   local port="${hostport##*:}"
   local security="none" net="tcp" sni="" wspath="/"
+  urldecode() {  # %2F etc. — share-link exports percent-encode path/sni
+    local s="${1//+/ }"
+    printf '%b' "${s//%/\\x}"
+  }
   local kv
   IFS='&' read -ra kv <<< "$query"
   for pair in "${kv[@]}"; do
     case "$pair" in
-      security=*) security="${pair#*=}" ;;
-      type=*) net="${pair#*=}" ;;
-      sni=*) sni="${pair#*=}" ;;
-      path=*) wspath="${pair#*=}" ;;
+      security=*) security="$(urldecode "${pair#*=}")" ;;
+      type=*) net="$(urldecode "${pair#*=}")" ;;
+      sni=*) sni="$(urldecode "${pair#*=}")" ;;
+      path=*) wspath="$(urldecode "${pair#*=}")" ;;
     esac
   done
   [[ "$port" =~ ^[0-9]+$ ]] || { err "bad port in VLESS url: $port"; exit 1; }

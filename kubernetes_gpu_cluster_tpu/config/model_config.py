@@ -44,6 +44,9 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     # Serving dtype for weights/activations; fp32 accumulation on the MXU.
     dtype: str = "bfloat16"
+    # Weight-only quantization of the big matmuls ("int8" or None): halves
+    # the HBM weight-streaming bytes that bound decode (ops/quant.py).
+    quantization: Optional[str] = None
     max_model_len: int = 4096
 
     @property

@@ -193,8 +193,18 @@ def load_weights(path: str, cfg: ModelConfig,
         else:   # checkpoint ties even though config doesn't say so
             params["lm_head"] = np.ascontiguousarray(params["embed"].T)
 
+    if cfg.quantization:
+        # Host-side (numpy) so the device never sees the full-precision
+        # weights; the int8 tensors upload at half the bytes.
+        from ..ops.quant import quantize_params
+        params = quantize_params(params, cfg.quantization)
+
     def put(path_, x):
-        x = jnp.asarray(x, dtype=dtype)
+        name = path_[-1].key if hasattr(path_[-1], "key") else str(path_[-1])
+        if x.dtype == np.int8 or name.endswith("_scale"):
+            x = jnp.asarray(x)          # int8 weights / f32 scales as-is
+        else:
+            x = jnp.asarray(x, dtype=dtype)
         if shardings is not None:
             s = shardings
             for k in path_:

@@ -104,6 +104,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Optional[jnp.dtype] = N
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = w(next(keys), (d, cfg.vocab_size), d)
+    if cfg.quantization:
+        from ..ops.quant import quantize_params
+        params = quantize_params(params, cfg.quantization)
     return params
 
 
@@ -117,14 +120,26 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
 
 
+def _dot(x: jax.Array, lp: Params, name: str) -> jax.Array:
+    """x @ lp[name] in f32, transparently handling int8 weights: the int8->
+    bf16 convert fuses into the dot (weights stream from HBM at half the
+    bytes) and the per-output-channel scale applies to the f32 result
+    (ops/quant.py). Dense-precision weights take the plain path."""
+    w = lp[name]
+    if w.dtype == jnp.int8:
+        out = jnp.dot(x, w.astype(x.dtype), preferred_element_type=jnp.float32)
+        return out * lp[name + "_scale"]
+    return jnp.dot(x, w, preferred_element_type=jnp.float32)
+
+
 def _dense_mlp(lp: Params, x: jax.Array, tp_axis: Optional[str] = None) -> jax.Array:
     """Megatron MLP: gate/up column-sharded, down row-sharded. Under GSPMD
     (tp_axis=None) the psum is inserted by the partitioner; inside shard_map
     (parallel/pp.py) ``tp_axis`` names the manual mesh axis to reduce over."""
-    gate = jnp.dot(x, lp["w_gate"], preferred_element_type=jnp.float32)
-    up = jnp.dot(x, lp["w_up"], preferred_element_type=jnp.float32)
+    gate = _dot(x, lp, "w_gate")
+    up = _dot(x, lp, "w_up")
     h = (jax.nn.silu(gate) * up).astype(x.dtype)
-    out = jnp.dot(h, lp["w_down"], preferred_element_type=jnp.float32)
+    out = _dot(h, lp, "w_down")
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)
     return out.astype(x.dtype)
@@ -154,13 +169,17 @@ def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig,
         start = jax.lax.axis_index(ep_axis) * E_local
         combine = jax.lax.dynamic_slice_in_dim(combine, start, E_local, axis=1)
 
-    def expert_fn(wg, wu, wd):
-        gate = jnp.dot(x, wg, preferred_element_type=jnp.float32)
-        up = jnp.dot(x, wu, preferred_element_type=jnp.float32)
+    def expert_fn(ep_params):
+        gate = _dot(x, ep_params, "w_gate")
+        up = _dot(x, ep_params, "w_up")
         h = (jax.nn.silu(gate) * up).astype(x.dtype)
-        return jnp.dot(h, wd, preferred_element_type=jnp.float32)    # [T, d]
+        return _dot(h, ep_params, "w_down")                          # [T, d]
 
-    expert_outs = jax.vmap(expert_fn)(lp["w_gate"], lp["w_up"], lp["w_down"])  # [E_local, T, d]
+    expert_params = {k: lp[k] for k in
+                     ("w_gate", "w_up", "w_down",
+                      "w_gate_scale", "w_up_scale", "w_down_scale")
+                     if k in lp}
+    expert_outs = jax.vmap(expert_fn)(expert_params)  # [E_local, T, d]
     out = jnp.einsum("te,etd->td", combine, expert_outs)
     reduce_axes = tuple(a for a in (ep_axis, tp_axis) if a is not None)
     if reduce_axes:
@@ -173,9 +192,9 @@ def _qkv(lp: Params, cfg: ModelConfig, x: jax.Array, positions: jax.Array):
     Head counts are derived from the projection widths (not cfg) so the same
     code runs on tp-local shards inside shard_map (parallel/pp.py)."""
     T = x.shape[0]
-    q = jnp.dot(x, lp["wq"], preferred_element_type=jnp.float32)
-    k = jnp.dot(x, lp["wk"], preferred_element_type=jnp.float32)
-    v = jnp.dot(x, lp["wv"], preferred_element_type=jnp.float32)
+    q = _dot(x, lp, "wq")
+    k = _dot(x, lp, "wk")
+    v = _dot(x, lp, "wv")
     if cfg.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -202,10 +221,10 @@ def _mlp_block(lp: Params, cfg: ModelConfig, x: jax.Array,
 
 
 # ---------------------------------------------------------------------------
-# Forward passes (scan over stacked layers; KV pool threads through as xs/ys)
+# Forward passes (scan over stacked layers; attn addresses the pool by index)
 # ---------------------------------------------------------------------------
 
-def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array, kv: KVCache,
+def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array,
                 positions: jax.Array, attn_fn,
                 layer_slice=None,
                 tp_axis: Optional[str] = None,
@@ -248,7 +267,7 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array, kv: KVCache,
         q, k, v = _qkv(lp, cfg, x, positions)
         attn_out = attn_fn(lp, q, k, v, layer_idx)
         attn_out = attn_out.reshape(x.shape[0], -1)
-        o = jnp.dot(attn_out, lp["wo"], preferred_element_type=jnp.float32)
+        o = _dot(attn_out, lp, "wo")
         if tp_axis is not None:  # row-sharded wo: partial sums over local heads
             o = jax.lax.psum(o, tp_axis)
         h = resid + o.astype(h.dtype)
@@ -281,7 +300,7 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
         return ragged_prefill_attention(q, k, v, meta.seg_ids, meta.positions,
                                         scale, use_pallas=use_pallas)
 
-    h, k_all, v_all = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn,
+    h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn,
                                   layer_slice, tp_axis=tp_axis, ep_axis=ep_axis)
     if layer_slice is not None:
         kv = KVCache(k=kv.k[layer_slice[0]:layer_slice[1]],
@@ -316,7 +335,7 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
                                       meta.context_lens, k, v, scale,
                                       layer=layer_idx, use_pallas=use_pallas)
 
-    h, k_all, v_all = _layer_scan(params, cfg, h, kv, meta.positions, attn_fn,
+    h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn,
                                   layer_slice, tp_axis=tp_axis, ep_axis=ep_axis)
     new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
                                          meta.slot_mapping))
@@ -325,5 +344,7 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
 def compute_logits(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
     """hidden [B, d] -> logits [B, V] in fp32."""
-    w = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return jnp.dot(hidden, w, preferred_element_type=jnp.float32)
+    if cfg.tie_word_embeddings:
+        return jnp.dot(hidden, params["embed"].T,
+                       preferred_element_type=jnp.float32)
+    return _dot(hidden, params, "lm_head")

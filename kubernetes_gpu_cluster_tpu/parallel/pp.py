@@ -65,6 +65,20 @@ def _layer_specs(cfg: ModelConfig) -> dict:
         specs["w_gate"] = P("pp", None, "tp")
         specs["w_up"] = P("pp", None, "tp")
         specs["w_down"] = P("pp", "tp", None)
+    if cfg.quantization:
+        # int8 scales shard like their weight's OUT axis (cf. sharding.py).
+        specs["wq_scale"] = P("pp", "tp")
+        specs["wk_scale"] = P("pp", "tp")
+        specs["wv_scale"] = P("pp", "tp")
+        specs["wo_scale"] = P("pp")
+        if cfg.is_moe:
+            specs["w_gate_scale"] = P("pp", "ep", "tp")
+            specs["w_up_scale"] = P("pp", "ep", "tp")
+            specs["w_down_scale"] = P("pp", "ep")
+        else:
+            specs["w_gate_scale"] = P("pp", "tp")
+            specs["w_up_scale"] = P("pp", "tp")
+            specs["w_down_scale"] = P("pp")
     return specs
 
 
@@ -79,6 +93,8 @@ def param_pp_specs(cfg: ModelConfig) -> dict:
     }
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P()
+        if cfg.quantization:
+            specs["lm_head_scale"] = P()
     return specs
 
 

@@ -90,6 +90,24 @@ def param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict[str, Any]:
     }
     if not cfg.tie_word_embeddings:
         shardings["lm_head"] = _ns(mesh, None, "tp")
+    if cfg.quantization:
+        # Per-output-channel scales shard exactly like their weight's OUT
+        # axis (ops/quant.py): column-sharded weights carry sharded scales,
+        # row-sharded weights have unsharded outputs -> replicated scales.
+        layers["wq_scale"] = _ns(mesh, None, "tp")
+        layers["wk_scale"] = _ns(mesh, None, kv_tp)
+        layers["wv_scale"] = _ns(mesh, None, kv_tp)
+        layers["wo_scale"] = _ns(mesh)
+        if cfg.is_moe:
+            layers["w_gate_scale"] = _ns(mesh, None, "ep", "tp")
+            layers["w_up_scale"] = _ns(mesh, None, "ep", "tp")
+            layers["w_down_scale"] = _ns(mesh, None, "ep", None)
+        else:
+            layers["w_gate_scale"] = _ns(mesh, None, "tp")
+            layers["w_up_scale"] = _ns(mesh, None, "tp")
+            layers["w_down_scale"] = _ns(mesh)
+        if not cfg.tie_word_embeddings:
+            shardings["lm_head_scale"] = _ns(mesh, "tp")
     return shardings
 
 

@@ -339,6 +339,9 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--dtype", default=None,
                    help="serving dtype override (bfloat16/float32; float16 "
                    "maps to bfloat16 on TPU)")
+    p.add_argument("--quantization", default=None, choices=["int8"],
+                   help="weight-only int8 (W8A16): halves HBM weight "
+                   "streaming; applied to any checkpoint at load")
     p.add_argument("--enforce-eager", action="store_true",
                    help="disable jit compile caching (debug; always slower)")
     p.add_argument("--trust-remote-code", action="store_true",
@@ -359,6 +362,8 @@ def main(argv: Optional[list[str]] = None) -> None:
         dtype = {"float16": "bfloat16", "half": "bfloat16",
                  "bf16": "bfloat16"}.get(args.dtype, args.dtype)
         model_cfg = model_cfg.replace(dtype=dtype)
+    if args.quantization:
+        model_cfg = model_cfg.replace(quantization=args.quantization)
     if args.trust_remote_code or args.disable_custom_all_reduce:
         logger.info("GPU-parity flags accepted and ignored "
                     "(--trust-remote-code / --disable-custom-all-reduce)")
