@@ -113,6 +113,7 @@ class LLMEngine:
                                           kv_sharding)
 
         self._prefill_fn = self._build_prefill_fn()
+        self._prefill_hist_fn = self._build_prefill_hist_fn()
         # Two compiled window programs: all-greedy batches (the common
         # serving case) never trace sampling at all — argmax only. Selection
         # happens HOST-side per batch from its SamplingParams; a runtime
@@ -217,6 +218,27 @@ class LLMEngine:
             return next_tokens, kv
 
         return self._maybe_jit(prefill_step, donate_argnums=(1,))
+
+    def _build_prefill_hist_fn(self):
+        """Chunked-prefill step: one sequence's chunk attending to its pool
+        history (models.forward_prefill_hist). Extra inputs vs prefill:
+        page_table [1, pages_bucket] and hist_len scalar. Compiled lazily —
+        engines that never see a long prompt never pay for it."""
+        cfg = self.model_config
+
+        def prefill_hist_step(params, kv: KVCache, int_t, int_b, float_b,
+                              page_table, hist_len, key):
+            meta = PrefillMeta(seg_ids=int_t[1], positions=int_t[2],
+                               slot_mapping=int_t[3],
+                               logits_indices=int_b[:, 0])
+            hidden, kv = model_lib.forward_prefill_hist(
+                params, cfg, int_t[0], meta, kv, page_table[0], hist_len)
+            logits = model_lib.compute_logits(params, cfg, hidden)
+            next_tokens = sample_tokens(logits, key, float_b[:, 0],
+                                        int_b[:, 1], float_b[:, 1])
+            return next_tokens, kv
+
+        return self._maybe_jit(prefill_hist_step, donate_argnums=(1,))
 
     def _build_decode_fn(self, greedy: bool = False):
         """Multi-step decode: W autoregressive steps inside one XLA program.
@@ -346,15 +368,29 @@ class LLMEngine:
             float_b = jnp.asarray(
                 np.stack([batch.temperature, batch.top_p], axis=1))
             if batch.kind == "prefill":
-                self.stats.prefill_tokens += sum(
-                    s.num_tokens for s in batch.seqs)
                 int_t = jnp.asarray(np.stack(
                     [batch.tokens, batch.seg_ids, batch.positions,
                      batch.slot_mapping]))
                 int_b = jnp.asarray(np.stack(
                     [batch.logits_indices, batch.top_k], axis=1))
-                next_tokens, self.kv_cache = self._prefill_fn(
-                    self.params, self.kv_cache, int_t, int_b, float_b, step_key)
+                if batch.hist_len is not None:
+                    # Chunked prefill (solo): chunk attends to pool history.
+                    self.stats.prefill_tokens += int(
+                        np.sum(batch.seg_ids >= 0))
+                    next_tokens, self.kv_cache = self._prefill_hist_fn(
+                        self.params, self.kv_cache, int_t, int_b, float_b,
+                        jnp.asarray(batch.page_tables),
+                        jnp.int32(batch.hist_len), step_key)
+                    if batch.partial:
+                        # Prompt not complete: KV is committed, the sampled
+                        # token is meaningless — nothing to report yet.
+                        return drained
+                else:
+                    self.stats.prefill_tokens += sum(
+                        s.num_tokens for s in batch.seqs)
+                    next_tokens, self.kv_cache = self._prefill_fn(
+                        self.params, self.kv_cache, int_t, int_b, float_b,
+                        step_key)
                 return drained + self._process_window(
                     batch, np.asarray(next_tokens)[:, None], set(), defer=False)
             inflight = self._dispatch_window(

@@ -50,6 +50,11 @@ class ScheduledBatch:
     # decode only
     page_tables: Optional[np.ndarray] = None      # [B_pad, pages_bucket]
     context_lens: Optional[np.ndarray] = None     # [B_pad]
+    # chunked prefill only (solo batch): history length + this seq's pages
+    # (in page_tables [1, pages_bucket]); partial = prompt not yet complete
+    # after this chunk (the sampled token is discarded).
+    hist_len: Optional[int] = None
+    partial: bool = False
     # sampling arrays [B_pad]
     temperature: Optional[np.ndarray] = None
     top_k: Optional[np.ndarray] = None
@@ -91,7 +96,9 @@ class Scheduler:
     def add(self, seq: Sequence) -> None:
         if seq.num_prompt_tokens == 0:
             raise ValueError("prompt must contain at least one token")
-        max_prompt = min(self.config.effective_max_len - 1, self.prefill_buckets[-1])
+        # Prompts longer than the prefill token budget are CHUNKED across
+        # steps (vLLM chunked prefill); the model length cap still applies.
+        max_prompt = self.config.effective_max_len - 1
         if seq.num_prompt_tokens > max_prompt:
             raise ValueError(
                 f"prompt of {seq.num_prompt_tokens} tokens exceeds limit {max_prompt}")
@@ -110,6 +117,7 @@ class Scheduler:
                 self.waiting.remove(seq)
                 seq.status = SequenceStatus.FINISHED
                 seq.finish_reason = FinishReason.ABORT
+                self._release(seq)   # mid-chunk prefills hold pages
                 return True
         for seq in self.running:
             if seq.request_id == request_id:
@@ -143,10 +151,17 @@ class Scheduler:
         victim = self.running.pop()  # admission order => last is youngest
         self._release(victim)
         victim.status = SequenceStatus.PREEMPTED
+        victim.num_prefilled = 0     # pages gone: chunk progress recomputes
         # Recompute-style preemption: pages are gone; on readmission the
         # prefill replays all_token_ids (prompt + generated so far) so the
         # prompt/output split — and with it max_tokens accounting — is kept.
-        self.waiting.appendleft(victim)
+        # INVARIANT: a mid-chunk sequence (holding pages) is only ever at
+        # waiting[0] — chunk scheduling runs on the head alone, so displacing
+        # it would strand its pages forever. Preempted victims slot in behind.
+        if self.waiting and self.waiting[0].num_prefilled > 0:
+            self.waiting.insert(1, victim)
+        else:
+            self.waiting.appendleft(victim)
         self.num_preemptions += 1
         logger.warning("preempted %s (KV pages exhausted; free=%d)",
                        victim.request_id, self.allocator.num_free)
@@ -160,40 +175,68 @@ class Scheduler:
             return batch
         return self._schedule_decode()
 
+    # Bounded lookahead past a blocked queue head: fills the batch with
+    # later sequences that DO fit (no reordering — skipped sequences keep
+    # their place, so the head still goes first next round). Kills the
+    # head-of-line blocking where one large prompt stalled every small one
+    # behind it, while the bound prevents unbounded queue scans.
+    PREFILL_LOOKAHEAD = 8
+
     def _schedule_prefills(self) -> Optional[ScheduledBatch]:
+        # A sequence larger than the prefill token budget streams through in
+        # chunks, admitted solo (its chunk attends to its pool history).
+        # When the chunk is BLOCKED (no pages / batch full), fall through to
+        # lookahead admission — the head keeps first claim on freed pages
+        # (this branch runs before any admission on every schedule call), so
+        # small prompts behind it progress without starving it.
+        if self.waiting:
+            head = self.waiting[0]
+            if head.num_prefilled > 0 or head.num_tokens > self.max_prefill_tokens:
+                batch = self._schedule_chunk(head)
+                if batch is not None:
+                    return batch
+
         admitted: list[Sequence] = []
         total_tokens = 0
-        while self.waiting:
-            seq = self.waiting[0]
+        skipped = 0
+        i = 0
+        while i < len(self.waiting) and skipped <= self.PREFILL_LOOKAHEAD:
+            seq = self.waiting[i]
             if len(self.running) + len(admitted) >= self.max_num_seqs:
                 break
-            # A single oversized (recomputed) sequence may exceed the budget
-            # alone — admit it solo rather than starving it.
-            if admitted and total_tokens + seq.num_tokens > self.max_prefill_tokens:
-                break
+            if seq.num_tokens > self.max_prefill_tokens:
+                # Chunkable sequence mid-queue: solo-only, skip for this batch.
+                skipped += 1
+                i += 1
+                continue
+            fits_budget = (not admitted or
+                           total_tokens + seq.num_tokens <= self.max_prefill_tokens)
             need = cdiv(seq.num_tokens, self.page_size)
-            if not self.allocator.can_allocate(need):
-                # No pages for this prompt. Never preempt running sequences to
-                # admit waiting ones — the victim would re-enter the waiting
-                # queue ahead of this sequence and immediately re-take the
-                # freed pages, churning full-recompute prefills while starving
-                # decode. Decode continues; finishes will free pages.
-                if not self.running and not admitted:
-                    # Pool is empty and the sequence still doesn't fit: it has
-                    # grown (via preempt-recompute) past total capacity and
-                    # can never be scheduled — terminate it at capacity.
-                    self.waiting.popleft()
-                    seq.status = SequenceStatus.FINISHED
-                    seq.finish_reason = FinishReason.LENGTH
-                    self.terminally_finished.append(seq)
-                    logger.warning(
-                        "%s needs %d pages > pool capacity %d; finishing at "
-                        "length %d", seq.request_id, need,
-                        self.allocator.num_pages - 1, seq.num_tokens)
-                    continue
-                break
+            fits_pages = self.allocator.can_allocate(need)
+            if not fits_pages and i == 0 and not self.running and not admitted:
+                # Pool is empty and the head still doesn't fit: it has grown
+                # (via preempt-recompute) past total capacity and can never be
+                # scheduled — terminate it at capacity.
+                self.waiting.popleft()
+                self._release(seq)
+                seq.status = SequenceStatus.FINISHED
+                seq.finish_reason = FinishReason.LENGTH
+                self.terminally_finished.append(seq)
+                logger.warning(
+                    "%s needs %d pages > pool capacity %d; finishing at "
+                    "length %d", seq.request_id, need,
+                    self.allocator.num_pages - 1, seq.num_tokens)
+                continue
+            if not (fits_budget and fits_pages):
+                # Never preempt running sequences to admit waiting ones — the
+                # victim would re-enter the waiting queue ahead of this
+                # sequence and immediately re-take the freed pages, churning
+                # full-recompute prefills while starving decode.
+                skipped += 1
+                i += 1
+                continue
             seq.pages = self.allocator.allocate(need)
-            self.waiting.popleft()
+            del self.waiting[i]
             admitted.append(seq)
             total_tokens += seq.num_tokens
         if not admitted:
@@ -225,6 +268,75 @@ class Scheduler:
             kind="prefill", seqs=admitted, tokens=tokens, positions=positions,
             slot_mapping=slot_mapping, seg_ids=seg_ids,
             logits_indices=logits_indices, **self._sampling_arrays(admitted, B))
+
+    def _schedule_chunk(self, seq: Sequence) -> Optional[ScheduledBatch]:
+        """One chunk of a long prompt, admitted solo: tokens
+        [num_prefilled, num_prefilled + chunk) run as a prefill attending to
+        the sequence's committed pool history. On the final chunk the
+        sequence joins running (its sampled token is the first generation);
+        earlier chunks leave it at the queue head with progress advanced."""
+        remaining = seq.num_tokens - seq.num_prefilled
+        chunk = min(remaining, self.max_prefill_tokens)
+        if len(self.running) >= self.max_num_seqs:
+            return None
+        end = seq.num_prefilled + chunk
+        need = cdiv(end, self.page_size) - len(seq.pages)
+        if need > 0 and not self.allocator.can_allocate(need):
+            usable = self.allocator.num_pages - 1
+            if not self.running and cdiv(end, self.page_size) > usable:
+                # Can never fit even an empty pool: capacity-terminate.
+                self.waiting.popleft()
+                self._release(seq)
+                seq.status = SequenceStatus.FINISHED
+                seq.finish_reason = FinishReason.LENGTH
+                self.terminally_finished.append(seq)
+                logger.warning("%s chunked prefill exceeds pool capacity "
+                               "(%d pages); finishing", seq.request_id, usable)
+            return None        # wait for decode finishes to free pages
+        if need > 0:
+            seq.pages.extend(self.allocator.allocate(need))
+
+        partial = end < seq.num_tokens
+        T = _bucket(chunk, self.prefill_buckets)
+        tokens = np.zeros(T, np.int32)
+        seg_ids = np.full(T, -1, np.int32)
+        positions = np.zeros(T, np.int32)
+        slot_mapping = np.zeros(T, np.int32)
+        tokens[:chunk] = seq.all_token_ids[seq.num_prefilled:end]
+        seg_ids[:chunk] = 0
+        tok_pos = np.arange(seq.num_prefilled, end)
+        positions[:chunk] = tok_pos
+        page_arr = np.asarray(seq.pages, np.int64)
+        slot_mapping[:chunk] = (page_arr[tok_pos // self.page_size] *
+                                self.page_size + tok_pos % self.page_size)
+        # History table width buckets to the ACTUAL context (few power-of-2
+        # compile shapes), not the model cap — the attention materializes
+        # [heads, T, width*ps] scores, so a max-len-wide table would make
+        # every small chunk pay max-model-len memory/FLOPs.
+        max_pages = cdiv(self.config.effective_max_len, self.page_size)
+        width = min(next_power_of_2(max(len(seq.pages), 1)), max_pages)
+        page_table = np.zeros((1, width), np.int32)
+        page_table[0, :len(seq.pages)] = seq.pages
+        B = _bucket(1, self.decode_buckets)
+        logits_indices = np.zeros(B, np.int32)
+        logits_indices[0] = chunk - 1
+
+        hist_len = seq.num_prefilled
+        seq.num_prefilled = end
+        if partial:
+            logger.info("%s prefill chunk [%d:%d) of %d", seq.request_id,
+                        hist_len, end, seq.num_tokens)
+        else:
+            self.waiting.popleft()
+            seq.status = SequenceStatus.RUNNING
+            self.running.append(seq)
+
+        return ScheduledBatch(
+            kind="prefill", seqs=[seq], tokens=tokens, positions=positions,
+            slot_mapping=slot_mapping, seg_ids=seg_ids,
+            logits_indices=logits_indices, page_tables=page_table,
+            hist_len=hist_len, partial=partial,
+            **self._sampling_arrays([seq], B))
 
     def _schedule_decode(self) -> Optional[ScheduledBatch]:
         if not self.running:

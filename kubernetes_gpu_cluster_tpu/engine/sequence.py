@@ -38,6 +38,10 @@ class Sequence:
         self.pages: list[int] = []
         self.arrival_time = time.monotonic()
         self.first_token_time: Optional[float] = None  # for TTFT metrics
+        # Chunked prefill progress: tokens whose KV is already committed to
+        # the pool by earlier chunks. Reset on preemption (pages are freed,
+        # the prompt recomputes from scratch).
+        self.num_prefilled = 0
 
     @property
     def all_token_ids(self) -> list[int]:

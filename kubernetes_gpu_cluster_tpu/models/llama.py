@@ -34,6 +34,7 @@ from ..ops.rope import apply_rope, rope_cos_sin
 from ..ops.attention import (
     write_kv_pages_all,
     ragged_prefill_attention,
+    prefill_history_attention_xla,
     paged_decode_attention,
 )
 
@@ -309,6 +310,27 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
                                          meta.slot_mapping))
     selected = h[meta.logits_indices]
     return rms_norm(selected, params["final_norm"], cfg.rms_norm_eps), new_kv, h
+
+
+def forward_prefill_hist(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                         meta: PrefillMeta, kv: KVCache,
+                         page_table: jax.Array, hist_len: jax.Array):
+    """Chunked prefill: one sequence's chunk attending to its pool history +
+    itself causally (ops.attention.prefill_history_attention_xla). Returns
+    (normed_selected [1, d], new_kv)."""
+    scale = cfg.head_dim ** -0.5
+    h = params["embed"][tokens]
+
+    def attn_fn(lp, q, k, v, layer_idx):
+        return prefill_history_attention_xla(
+            q, k, v, meta.seg_ids, meta.positions, kv.k, kv.v,
+            page_table, hist_len, scale, layer=layer_idx)
+
+    h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn)
+    new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
+                                         meta.slot_mapping))
+    selected = h[meta.logits_indices]
+    return rms_norm(selected, params["final_norm"], cfg.rms_norm_eps), new_kv
 
 
 def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,

@@ -122,6 +122,61 @@ def ragged_prefill_attention_xla(
     return out.reshape(T, n_heads, hd).astype(q.dtype)
 
 
+def prefill_history_attention_xla(
+    q: jax.Array,            # [T, n_heads, hd] (post-RoPE) — ONE sequence's chunk
+    k: jax.Array,            # [T, n_kv, hd] (this chunk's keys)
+    v: jax.Array,            # [T, n_kv, hd]
+    seg_ids: jax.Array,      # [T] int32: 0 for chunk tokens, -1 padding
+    positions: jax.Array,    # [T] int32 GLOBAL positions (offset by history)
+    k_pool: jax.Array,       # [P, ps, n_kv*hd] or [L, P, ps, n_kv*hd]
+    v_pool: jax.Array,
+    page_table: jax.Array,   # [pages_per_seq] int32 (this sequence's pages)
+    hist_len: jax.Array,     # [] int32 tokens already committed to the pool
+    scale: float,
+    layer: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Chunked-prefill attention: causal within the chunk PLUS full attention
+    to the sequence's already-committed history in the paged pool.
+
+    This is what lets a prompt longer than the prefill token budget stream
+    through in chunks (vLLM's chunked prefill; the reference exposed the knob
+    through its chart schema). One sequence per call — the scheduler admits
+    chunked prefills solo — so the history gather is [H, kd], not [T, H, kd].
+    XLA implementation; the flash-kernel variant is a planned upgrade.
+    """
+    if layer is not None and k_pool.ndim == 4:
+        k_pool = jax.lax.dynamic_index_in_dim(k_pool, layer, 0, keepdims=False)
+        v_pool = jax.lax.dynamic_index_in_dim(v_pool, layer, 0, keepdims=False)
+    T, n_heads, hd = q.shape
+    n_kv = k.shape[1]
+    ps = k_pool.shape[1]
+    H = page_table.shape[0] * ps
+    q_per_kv = n_heads // n_kv
+
+    k_hist = k_pool[page_table].reshape(H, n_kv, hd).astype(jnp.float32)
+    v_hist = v_pool[page_table].reshape(H, n_kv, hd).astype(jnp.float32)
+
+    qg = (q.astype(jnp.float32) * scale).reshape(T, n_kv, q_per_kv, hd)
+    # history scores: all valid history positions attend (they precede the chunk)
+    s_h = jnp.einsum("tkgh,skh->kgts", qg, k_hist)          # [n_kv, g, T, H]
+    valid_h = (jnp.arange(H)[None, :] < hist_len) & (seg_ids[:, None] >= 0)
+    s_h = jnp.where(valid_h[None, None], s_h, -jnp.inf)
+    # in-chunk causal scores (same as ragged prefill)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    s_b = jnp.einsum("tkgh,skh->kgts", qg, kf)              # [n_kv, g, T, T]
+    same = (seg_ids[:, None] == seg_ids[None, :]) & (seg_ids[:, None] >= 0)
+    causal = positions[:, None] >= positions[None, :]
+    s_b = jnp.where((same & causal)[None, None], s_b, -jnp.inf)
+
+    s = jnp.concatenate([s_h, s_b], axis=-1)                # [n_kv, g, T, H+T]
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)                     # fully-masked rows
+    out = (jnp.einsum("kgts,skh->tkgh", p[..., :H], v_hist)
+           + jnp.einsum("kgts,skh->tkgh", p[..., H:], vf))
+    return out.reshape(T, n_heads, hd).astype(q.dtype)
+
+
 # ---------------------------------------------------------------------------
 # Paged decode attention
 # ---------------------------------------------------------------------------
