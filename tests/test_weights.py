@@ -123,3 +123,39 @@ class TestEngineWithRealWeights:
                 hf_tokens.append(nxt)
                 ids = torch.cat([ids, torch.tensor([[nxt]])], dim=1)
         assert out.output_token_ids == hf_tokens
+
+
+class TestRopeScaling:
+    def test_llama3_rope_scaling_parsed_and_applied(self, tmp_path):
+        import json
+        import numpy as np
+        from kubernetes_gpu_cluster_tpu.engine.weights import config_from_hf
+        from kubernetes_gpu_cluster_tpu.ops.rope import scaled_inv_freq
+        hf = {"architectures": ["LlamaForCausalLM"], "vocab_size": 128,
+              "hidden_size": 64, "intermediate_size": 128,
+              "num_hidden_layers": 2, "num_attention_heads": 4,
+              "num_key_value_heads": 2, "rope_theta": 500000.0,
+              "rope_scaling": {"rope_type": "llama3", "factor": 8.0,
+                               "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                               "original_max_position_embeddings": 8192}}
+        (tmp_path / "config.json").write_text(json.dumps(hf))
+        cfg = config_from_hf(str(tmp_path))
+        scaling = cfg.rope_scaling_dict
+        assert scaling["rope_type"] == "llama3"
+        scaled = scaled_inv_freq(cfg.head_dim, cfg.rope_theta, scaling)
+        plain = scaled_inv_freq(cfg.head_dim, cfg.rope_theta, None)
+        # high-frequency components untouched; lowest stretched by ~factor
+        assert np.isclose(scaled[0], plain[0])
+        assert np.isclose(scaled[-1], plain[-1] / 8.0, rtol=0.2)
+
+    def test_unsupported_rope_scaling_rejected(self, tmp_path):
+        import json
+        import pytest
+        from kubernetes_gpu_cluster_tpu.engine.weights import config_from_hf
+        hf = {"architectures": ["LlamaForCausalLM"], "vocab_size": 128,
+              "hidden_size": 64, "intermediate_size": 128,
+              "num_hidden_layers": 2, "num_attention_heads": 4,
+              "rope_scaling": {"rope_type": "yarn", "factor": 4.0}}
+        (tmp_path / "config.json").write_text(json.dumps(hf))
+        with pytest.raises(ValueError, match="yarn"):
+            config_from_hf(str(tmp_path))
