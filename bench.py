@@ -42,7 +42,10 @@ import os
 
 BATCH = int(os.environ.get("KGCT_BENCH_BATCH", 64))
 PROMPT_LEN = int(os.environ.get("KGCT_BENCH_PROMPT", 128))
-PAGE = int(os.environ.get("KGCT_BENCH_PAGE", 16))
+# None = the engine's backend-derived page size (128 on TPU, 16 on CPU), so
+# the bench measures the SHIPPED default config.
+PAGE = (int(os.environ["KGCT_BENCH_PAGE"])
+        if os.environ.get("KGCT_BENCH_PAGE") else None)
 # Substeps per XLA program. Sized so device time per window (~3 ms/substep on
 # v5e) comfortably exceeds the host round trip (~110 ms on the tunnel-attached
 # chip) — the speculative window chain then fully hides the host.
@@ -66,10 +69,11 @@ def main() -> None:
     on_tpu = backend == "tpu"
     model_name = "tinyllama-1.1b" if on_tpu else "debug-tiny"
     quant = os.environ.get("KGCT_BENCH_QUANT") or None
-    pages_per_seq = (PROMPT_LEN + MAX_NEW_TOKENS) // PAGE + 3
+    page = PAGE if PAGE is not None else (128 if on_tpu else 16)
+    pages_per_seq = (PROMPT_LEN + MAX_NEW_TOKENS) // page + 3
     cfg = EngineConfig(
         model=get_model_config(model_name).replace(quantization=quant),
-        cache=CacheConfig(page_size=PAGE, num_pages=BATCH * pages_per_seq + 1),
+        cache=CacheConfig(page_size=page, num_pages=BATCH * pages_per_seq + 1),
         scheduler=SchedulerConfig(
             max_num_seqs=BATCH, max_prefill_tokens=2048,
             decode_buckets=(BATCH,), prefill_buckets=(2048,),

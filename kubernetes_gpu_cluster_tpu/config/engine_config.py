@@ -21,7 +21,11 @@ from .model_config import ModelConfig, get_model_config
 class CacheConfig:
     """Paged KV cache sizing (reference knob: gpuMemoryUtilization 0.90-0.99,
     maxModelLen 128-4096 — values-01-minimal-example4.yaml:19-22, ...8.yaml:26-27)."""
-    page_size: int = 16                # tokens per KV page
+    # Tokens per KV page. None = backend-derived at engine init: 128 on TPU
+    # (the decode kernel then streams one page per DMA chunk — fewest DMA
+    # issues, measured fastest), 16 elsewhere (finest pool granularity for
+    # small test pools). Set explicitly to pin it.
+    page_size: Optional[int] = None
     num_pages: Optional[int] = None    # explicit page count; None = derive from HBM
     hbm_utilization: float = 0.90      # fraction of free HBM to give the KV cache
     dtype: Optional[str] = None        # KV dtype; None = model dtype

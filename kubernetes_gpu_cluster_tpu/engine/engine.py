@@ -77,6 +77,11 @@ class LLMEngine:
                  eos_token_id: Optional[int] = None,
                  mesh: Optional[jax.sharding.Mesh] = None,
                  use_pallas: Optional[bool] = None):
+        if config.cache.page_size is None:
+            # Backend-derived default (see CacheConfig.page_size).
+            ps = 128 if jax.default_backend() == "tpu" else 16
+            config = dataclasses.replace(
+                config, cache=dataclasses.replace(config.cache, page_size=ps))
         self.config = config
         self.model_config = config.model
         self.eos_token_id = eos_token_id
@@ -165,10 +170,12 @@ class LLMEngine:
 
         cfg = self.model_config
         ps = self.config.cache.page_size
-        # pps >= the kernel's default chunk_pages (8): pallas_paged_decode
-        # caps its chunk at min(chunk_pages, pps), so a smaller probe would
-        # compile a different (smaller-scratch) kernel than serving runs and
-        # could pass while the real configuration fails.
+        # pps >= the kernel's DERIVED chunk_pages (max(1, 128 // page_size),
+        # see pallas_paged_decode): the kernel caps its chunk at
+        # min(chunk_pages, pps), so a probe with smaller pps would compile a
+        # different (smaller-scratch) kernel than serving runs and could pass
+        # while the real configuration fails. pps=8 covers the derivation for
+        # every page_size >= 16.
         B, pps = 4, 8
         kd = cfg.num_kv_heads * cfg.head_dim
         q = jnp.zeros((B, cfg.num_heads, cfg.head_dim), cfg.jnp_dtype)

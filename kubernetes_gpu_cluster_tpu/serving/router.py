@@ -170,8 +170,9 @@ class Router:
 
     # -- proxying ------------------------------------------------------------
 
-    def _pick(self) -> Optional[Replica]:
-        healthy = [r for r in self.replicas if r.healthy]
+    def _pick(self, exclude: Optional[set] = None) -> Optional[Replica]:
+        healthy = [r for r in self.replicas
+                   if r.healthy and (not exclude or r.url not in exclude)]
         if not healthy:
             return None
         least = min(r.inflight for r in healthy)
@@ -179,45 +180,90 @@ class Router:
         return tied[next(self._rr) % len(tied)]
 
     async def proxy(self, request: web.Request) -> web.StreamResponse:
-        replica = self._pick()
-        if replica is None:
-            return web.json_response(
-                {"error": {"message": "no healthy replicas", "code": 503}},
-                status=503)
+        """Reverse-proxy with failover.
+
+        Only CONNECT-phase failures (replica down/unreachable) fail over to
+        the next healthy replica — a request the upstream already received
+        may be mid-generation there, and re-sending it would silently double
+        device work under exactly the overload that causes resets. Upstream
+        errors after the body was delivered return 502; after streaming to
+        the client started, the stream is terminated (truncation is the
+        signal). Client-side disconnects never count against the replica."""
         body = await request.read()
-        replica.inflight += 1
-        resp: Optional[web.StreamResponse] = None
-        try:
-            async with self._session.request(
-                    request.method, f"{replica.url}{request.path_qs}",
-                    data=body if body else None,
-                    headers={k: v for k, v in request.headers.items()
-                             if k.lower() not in HOP_HEADERS}) as upstream:
-                resp = web.StreamResponse(status=upstream.status)
-                for k, v in upstream.headers.items():
-                    if k.lower() not in HOP_HEADERS:
-                        resp.headers[k] = v
-                await resp.prepare(request)
-                async for chunk in upstream.content.iter_any():
-                    await resp.write(chunk)
-                await resp.write_eof()
-                return resp
-        except aiohttp.ClientError as e:
-            replica.consecutive_failures += 1
-            if replica.consecutive_failures >= self.fail_threshold:
-                replica.healthy = False
-            if resp is not None and resp.prepared:
-                # The response already started streaming to the client — a
-                # fresh json_response on the same request would corrupt the
-                # wire. Terminate what we have; the truncation is the signal.
-                with contextlib.suppress(Exception):
+        tried: set[str] = set()
+        last_err: Optional[Exception] = None
+        while True:
+            replica = self._pick(exclude=tried)
+            if replica is None:
+                break
+            tried.add(replica.url)
+            replica.inflight += 1
+            try:
+                try:
+                    upstream_cm = self._session.request(
+                        request.method, f"{replica.url}{request.path_qs}",
+                        data=body if body else None,
+                        headers={k: v for k, v in request.headers.items()
+                                 if k.lower() not in HOP_HEADERS})
+                    upstream = await upstream_cm.__aenter__()
+                except aiohttp.ClientConnectorError as e:
+                    # TCP connect failed: nothing reached the upstream —
+                    # safe to fail over.
+                    last_err = e
+                    self._count_failure(replica, e)
+                    continue
+                except aiohttp.ClientError as e:
+                    # Request sent (at least partially) but no response: the
+                    # upstream may already be processing it — do NOT re-send.
+                    last_err = e
+                    self._count_failure(replica, e)
+                    break
+                try:
+                    resp = web.StreamResponse(status=upstream.status)
+                    for k, v in upstream.headers.items():
+                        if k.lower() not in HOP_HEADERS:
+                            resp.headers[k] = v
+                    await resp.prepare(request)
+                    while True:
+                        try:
+                            chunk = await upstream.content.readany()
+                        except aiohttp.ClientError as e:
+                            # Upstream died mid-stream: the replica is suspect;
+                            # the client stream is already committed —
+                            # terminate it (truncation is the signal).
+                            self._count_failure(replica, e)
+                            with contextlib.suppress(Exception):
+                                await resp.write_eof()
+                            return resp
+                        if not chunk:
+                            break
+                        try:
+                            await resp.write(chunk)
+                        except (ConnectionError, aiohttp.ClientError):
+                            # CLIENT went away — not the replica's fault; no
+                            # failure accounting.
+                            return resp
                     await resp.write_eof()
-                return resp
+                    return resp
+                finally:
+                    await upstream_cm.__aexit__(None, None, None)
+            finally:
+                replica.inflight -= 1
+        if last_err is not None:
             return web.json_response(
-                {"error": {"message": f"upstream error: {e}", "code": 502}},
+                {"error": {"message": f"upstream error: {last_err}",
+                           "code": 502}},
                 status=502)
-        finally:
-            replica.inflight -= 1
+        return web.json_response(
+            {"error": {"message": "no healthy replicas", "code": 503}},
+            status=503)
+
+    def _count_failure(self, replica: Replica, err: Exception) -> None:
+        replica.consecutive_failures += 1
+        if replica.consecutive_failures >= self.fail_threshold:
+            replica.healthy = False
+            logger.warning("replica %s marked unhealthy (%s)",
+                           replica.url, err)
 
 
 def main(argv: Optional[list[str]] = None) -> None:

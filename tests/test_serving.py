@@ -217,3 +217,49 @@ class TestRouter:
             finally:
                 await rclient.close()
         loop.run_until_complete(go())
+
+
+class TestRouterFailover:
+    def test_failover_to_next_replica_before_streaming(self, event_loop=None):
+        """An upstream that refuses the connection is retried on the next
+        healthy replica; the client sees a single successful response."""
+        import asyncio
+        import aiohttp
+        from aiohttp import web as aioweb
+        from kubernetes_gpu_cluster_tpu.serving.router import Router
+
+        async def scenario():
+            # live replica
+            async def ok(request):
+                return aioweb.json_response({"from": "live"})
+            app = aioweb.Application()
+            app.router.add_post("/v1/completions", ok)
+            runner = aioweb.AppRunner(app)
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = runner.addresses[0][1]
+
+            # dead replica first in the list (connection refused)
+            router = Router([f"http://127.0.0.1:1",      # nothing listens
+                             f"http://127.0.0.1:{port}"],
+                            health_interval_s=9999)
+            rapp = router.build_app()
+            rrunner = aioweb.AppRunner(rapp)
+            await rrunner.setup()
+            rsite = aioweb.TCPSite(rrunner, "127.0.0.1", 0)
+            await rsite.start()
+            rport = rrunner.addresses[0][1]
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                            f"http://127.0.0.1:{rport}/v1/completions",
+                            json={"prompt": "x"}) as resp:
+                        assert resp.status == 200
+                        data = await resp.json()
+                        assert data["from"] == "live"
+            finally:
+                await rrunner.cleanup()
+                await runner.cleanup()
+
+        asyncio.run(scenario())
