@@ -46,10 +46,11 @@ PROMPT_LEN = int(os.environ.get("KGCT_BENCH_PROMPT", 128))
 # the bench measures the SHIPPED default config.
 PAGE = (int(os.environ["KGCT_BENCH_PAGE"])
         if os.environ.get("KGCT_BENCH_PAGE") else None)
-# Substeps per XLA program. Sized so device time per window (~3 ms/substep on
-# v5e) comfortably exceeds the host round trip (~110 ms on the tunnel-attached
-# chip) — the speculative window chain then fully hides the host.
-DECODE_WINDOW = int(os.environ.get("KGCT_BENCH_WINDOW", 64))
+# Substeps per XLA program. 32 measures best end-to-end on the tunnel chip
+# (A/B vs 64: larger windows grow per-window device time past what extra
+# host-RT amortization buys back, and push contexts longer for the same
+# token budget).
+DECODE_WINDOW = int(os.environ.get("KGCT_BENCH_WINDOW", 32))
 WARMUP_WINDOWS = 3
 BENCH_WINDOWS = int(os.environ.get("KGCT_BENCH_WINDOWS", 12))
 MAX_NEW_TOKENS = PROMPT_LEN + DECODE_WINDOW * (WARMUP_WINDOWS + BENCH_WINDOWS + 4)
