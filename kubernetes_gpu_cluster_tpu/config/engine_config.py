@@ -47,6 +47,10 @@ class SchedulerConfig:
     # are checked on the host after each window; tokens generated past a stop
     # are discarded.
     decode_window: int = 8
+    # Automatic prefix caching (vLLM enablePrefixCaching parity): completed
+    # prompts' full KV pages are content-addressed and reused by later
+    # requests sharing a page-aligned prefix (engine/kv_cache.PrefixCache).
+    enable_prefix_caching: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

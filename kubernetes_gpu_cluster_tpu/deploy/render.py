@@ -83,6 +83,9 @@ def _engine_args(spec: dict) -> list[str]:
         args += ["--hbm-utilization", str(cfg["gpuMemoryUtilization"])]
     if cfg.get("maxModelLen") is not None:
         args += ["--max-model-len", str(cfg["maxModelLen"])]
+    if cfg.get("enablePrefixCaching"):
+        args += ["--enable-prefix-caching"]
+    # enableChunkedPrefill needs no flag: long prompts always chunk here.
     if os.path.isabs(str(spec["modelURL"])):
         # Local checkpoint dir (hostPath-mounted): weights + tokenizer live
         # there (reference local-model story, values-…3.yaml:22-30).

@@ -21,10 +21,13 @@ paged cache is native:
 
 from __future__ import annotations
 
+import hashlib
+from collections import OrderedDict
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..config import ModelConfig, CacheConfig
 from ..utils import cdiv, get_logger
@@ -142,3 +145,126 @@ class PageAllocator:
 
     def pages_for_tokens(self, num_tokens: int) -> int:
         return cdiv(num_tokens, self.page_size)
+
+
+class PrefixCache:
+    """Automatic prefix caching: full prompt pages are content-addressed by a
+    CHAINED digest (page i's key commits to all tokens 0..(i+1)*ps), so a new
+    request whose prompt shares a page-aligned prefix with any previously
+    served one reuses those KV pages instead of recomputing them — the
+    vLLM `enable_prefix_caching` capability, TPU-shaped: a cache hit turns
+    admission into a chunked prefill whose "history" is the shared pages, so
+    no new kernel is needed.
+
+    Ownership: the cache holds ONE refcount on every cached page (pages are
+    append-only, so content can never change while a reference exists).
+    Sequences that reuse a page fork it (+1). Eviction is LRU and drops only
+    the cache's own reference; pages still used by live sequences survive
+    until their refcount drains. Digests are blake2b-chained — no
+    Python-hash collisions serving wrong context.
+    """
+
+    def __init__(self, allocator: "PageAllocator"):
+        self.allocator = allocator
+        self._entries: "OrderedDict[bytes, int]" = OrderedDict()  # digest->page
+        # digest -> child digests: a chained child is only reachable through
+        # its parent, so eviction must take descendants along or they would
+        # sit unreachable while pinning page references.
+        self._children: dict[bytes, set] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @staticmethod
+    def _page_digests(token_ids: list[int], n_pages: int, ps: int):
+        """Chained blake2b digest per full page (one array conversion)."""
+        raw = np.asarray(token_ids[:n_pages * ps], np.int32).tobytes()
+        digests = []
+        digest = b""
+        for i in range(n_pages):
+            h = hashlib.blake2b(digest, digest_size=16)
+            h.update(raw[i * ps * 4:(i + 1) * ps * 4])
+            digest = h.digest()
+            digests.append(digest)
+        return digests
+
+    def lookup(self, token_ids: list[int]) -> tuple[list[int], int]:
+        """Longest page-aligned cached prefix of ``token_ids``. Returns
+        (forked page ids, matched token count) — caller owns one reference
+        per returned page."""
+        ps = self.allocator.page_size
+        pages: list[int] = []
+        matched = 0
+        for digest in self._page_digests(token_ids, len(token_ids) // ps, ps):
+            page = self._entries.get(digest)
+            if page is None:
+                break
+            self._entries.move_to_end(digest)       # LRU touch
+            pages.append(page)
+            matched += ps
+        for p in pages:
+            self.allocator.fork(p)
+        if matched:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return pages, matched
+
+    def register(self, token_ids: list[int], pages: list[int]) -> None:
+        """Register the full pages backing ``token_ids`` (a completed prompt
+        prefill). First registration of a digest wins; already-cached pages
+        are left alone (dedupe)."""
+        ps = self.allocator.page_size
+        n = min(len(pages), len(token_ids) // ps)
+        parent = b""
+        for i, digest in enumerate(self._page_digests(token_ids, n, ps)):
+            if digest not in self._entries:
+                self.allocator.fork(pages[i])       # the cache's reference
+                self._entries[digest] = pages[i]
+                if parent:
+                    self._children.setdefault(parent, set()).add(digest)
+            parent = digest
+
+    def evict(self, n_pages: int) -> int:
+        """Drop LRU entries (each with its now-unreachable descendants)
+        until ``n_pages`` entries were dropped or the cache is empty.
+        Freeing only releases the cache's reference — shared pages stay
+        alive for their sequences."""
+        dropped = 0
+        while dropped < n_pages and self._entries:
+            digest, _ = next(iter(self._entries.items()))  # LRU head
+            dropped += self._drop_subtree(digest)
+        return dropped
+
+    def _drop_subtree(self, digest: bytes) -> int:
+        dropped = 0
+        stack = [digest]
+        while stack:
+            d = stack.pop()
+            page = self._entries.pop(d, None)
+            if page is None:
+                continue
+            self.allocator.free([page])
+            dropped += 1
+            stack.extend(self._children.pop(d, ()))
+        return dropped
+
+
+class CachingPageAllocator(PageAllocator):
+    """PageAllocator that transparently evicts prefix-cache entries under
+    pressure, so every existing can_allocate/allocate call site (scheduler
+    admission, decode window growth, chunk growth) gets eviction for free."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        super().__init__(num_pages, page_size)
+        self.prefix_cache = PrefixCache(self)
+
+    def can_allocate(self, n: int) -> bool:
+        # Evicting an entry only frees its page when no live sequence shares
+        # it, so keep evicting until satisfied or the cache runs dry.
+        while len(self._free) < n and len(self.prefix_cache):
+            if self.prefix_cache.evict(n - len(self._free)) == 0:
+                break
+        return len(self._free) >= n

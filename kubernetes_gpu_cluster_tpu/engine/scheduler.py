@@ -29,7 +29,7 @@ import numpy as np
 from ..config import EngineConfig
 from ..utils import cdiv, get_logger
 from ..utils.math import next_power_of_2
-from .kv_cache import PageAllocator
+from .kv_cache import CachingPageAllocator, PageAllocator
 from .sequence import FinishReason, Sequence, SequenceStatus
 
 logger = get_logger("scheduler")
@@ -81,7 +81,12 @@ class Scheduler:
         self.decode_buckets = sc.decode_buckets
         self.prefill_buckets = sc.prefill_buckets
         self.page_size = config.cache.page_size
-        self.allocator = PageAllocator(num_pages, self.page_size)
+        if sc.enable_prefix_caching:
+            self.allocator = CachingPageAllocator(num_pages, self.page_size)
+            self.prefix_cache = self.allocator.prefix_cache
+        else:
+            self.allocator = PageAllocator(num_pages, self.page_size)
+            self.prefix_cache = None
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
         # Sequences terminated by the scheduler itself (grown past pool
@@ -152,6 +157,8 @@ class Scheduler:
         self._release(victim)
         victim.status = SequenceStatus.PREEMPTED
         victim.num_prefilled = 0     # pages gone: chunk progress recomputes
+        victim.prefix_checked = False  # re-lookup on readmission (cheap TTFT
+                                       # recovery when the prefix is cached)
         # Recompute-style preemption: pages are gone; on readmission the
         # prefill replays all_token_ids (prompt + generated so far) so the
         # prompt/output split — and with it max_tokens accounting — is kept.
@@ -191,6 +198,26 @@ class Scheduler:
         # small prompts behind it progress without starving it.
         if self.waiting:
             head = self.waiting[0]
+            if (self.prefix_cache is not None and not head.prefix_checked
+                    and head.num_prefilled == 0 and not head.pages):
+                head.prefix_checked = True
+                # Prefix-cache reuse rides the chunked-prefill machinery: a
+                # cached page-aligned prefix becomes "already prefilled
+                # history" and only the tail is computed.
+                pages, matched = self.prefix_cache.lookup(head.all_token_ids)
+                # Always leave >=1 token to prefill (sampling reads the last
+                # prompt token's hidden state).
+                while matched >= head.num_tokens:
+                    self.allocator.free([pages.pop()])
+                    matched -= self.page_size
+                if matched > 0:
+                    head.pages = pages
+                    head.num_prefilled = matched
+                    logger.info("%s: prefix cache hit, %d/%d tokens reused",
+                                head.request_id, matched, head.num_tokens)
+                else:
+                    for p in pages:
+                        self.allocator.free([p])
             if head.num_prefilled > 0 or head.num_tokens > self.max_prefill_tokens:
                 batch = self._schedule_chunk(head)
                 if batch is not None:
@@ -212,7 +239,10 @@ class Scheduler:
             fits_budget = (not admitted or
                            total_tokens + seq.num_tokens <= self.max_prefill_tokens)
             need = cdiv(seq.num_tokens, self.page_size)
-            fits_pages = self.allocator.can_allocate(need)
+            # Budget first: can_allocate may EVICT prefix-cache entries to
+            # satisfy the probe, which must not happen for candidates the
+            # token budget rejects anyway.
+            fits_pages = fits_budget and self.allocator.can_allocate(need)
             if not fits_pages and i == 0 and not self.running and not admitted:
                 # Pool is empty and the head still doesn't fit: it has grown
                 # (via preempt-recompute) past total capacity and can never be
@@ -239,6 +269,7 @@ class Scheduler:
             del self.waiting[i]
             admitted.append(seq)
             total_tokens += seq.num_tokens
+            self._register_prefix(seq)
         if not admitted:
             return None
 
@@ -330,6 +361,7 @@ class Scheduler:
             self.waiting.popleft()
             seq.status = SequenceStatus.RUNNING
             self.running.append(seq)
+            self._register_prefix(seq)
 
         return ScheduledBatch(
             kind="prefill", seqs=[seq], tokens=tokens, positions=positions,
@@ -337,6 +369,18 @@ class Scheduler:
             logits_indices=logits_indices, page_tables=page_table,
             hist_len=hist_len, partial=partial,
             **self._sampling_arrays([seq], B))
+
+    def _register_prefix(self, seq: Sequence) -> None:
+        """Content-address this sequence's full PROMPT pages so later
+        requests sharing the prefix reuse them. Called at prompt-prefill
+        scheduling time — the KV is committed before any later schedule()
+        can hand the pages to another request (single-threaded step loop)."""
+        if self.prefix_cache is None:
+            return
+        full = seq.num_prompt_tokens // self.page_size
+        if full:
+            self.prefix_cache.register(seq.prompt_token_ids,
+                                       seq.pages[:full])
 
     def _schedule_decode(self) -> Optional[ScheduledBatch]:
         if not self.running:

@@ -42,6 +42,9 @@ class Sequence:
         # the pool by earlier chunks. Reset on preemption (pages are freed,
         # the prompt recomputes from scratch).
         self.num_prefilled = 0
+        # Prefix-cache lookup done (one per (re)admission — a blocked head is
+        # rescheduled many times and must not re-hash/re-fork per call).
+        self.prefix_checked = False
 
     @property
     def all_token_ids(self) -> list[int]:

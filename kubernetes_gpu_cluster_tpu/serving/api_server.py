@@ -342,6 +342,9 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--quantization", default=None, choices=["int8"],
                    help="weight-only int8 (W8A16): halves HBM weight "
                    "streaming; applied to any checkpoint at load")
+    p.add_argument("--enable-prefix-caching", action="store_true",
+                   help="reuse KV pages across requests sharing a "
+                   "page-aligned prompt prefix (vLLM parity)")
     p.add_argument("--enforce-eager", action="store_true",
                    help="disable jit compile caching (debug; always slower)")
     p.add_argument("--trust-remote-code", action="store_true",
@@ -371,7 +374,9 @@ def main(argv: Optional[list[str]] = None) -> None:
     config = EngineConfig(
         model=model_cfg,
         cache=CacheConfig(hbm_utilization=args.hbm_utilization),
-        scheduler=SchedulerConfig(max_num_seqs=args.max_num_seqs),
+        scheduler=SchedulerConfig(
+            max_num_seqs=args.max_num_seqs,
+            enable_prefix_caching=args.enable_prefix_caching),
         parallel=ParallelConfig(tp=args.tensor_parallel_size,
                                 pp=args.pipeline_parallel_size),
         max_model_len=args.max_model_len,
