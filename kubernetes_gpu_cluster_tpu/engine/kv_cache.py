@@ -179,25 +179,28 @@ class PrefixCache:
 
     @staticmethod
     def _page_digests(token_ids: list[int], n_pages: int, ps: int):
-        """Chained blake2b digest per full page (one array conversion)."""
+        """Chained blake2b digest per full page, yielded lazily (a lookup
+        that misses on page 0 must not hash a hundred-page prompt)."""
         raw = np.asarray(token_ids[:n_pages * ps], np.int32).tobytes()
-        digests = []
         digest = b""
         for i in range(n_pages):
             h = hashlib.blake2b(digest, digest_size=16)
             h.update(raw[i * ps * 4:(i + 1) * ps * 4])
             digest = h.digest()
-            digests.append(digest)
-        return digests
+            yield digest
 
-    def lookup(self, token_ids: list[int]) -> tuple[list[int], int]:
-        """Longest page-aligned cached prefix of ``token_ids``. Returns
-        (forked page ids, matched token count) — caller owns one reference
-        per returned page."""
+    def lookup(self, token_ids: list[int],
+               max_tokens: Optional[int] = None) -> tuple[list[int], int]:
+        """Longest page-aligned cached prefix of ``token_ids`` (capped at
+        ``max_tokens``). Returns (forked page ids, matched token count) —
+        caller owns one reference per returned page."""
         ps = self.allocator.page_size
+        n = len(token_ids) // ps
+        if max_tokens is not None:
+            n = min(n, max_tokens // ps)
         pages: list[int] = []
         matched = 0
-        for digest in self._page_digests(token_ids, len(token_ids) // ps, ps):
+        for digest in self._page_digests(token_ids, n, ps):
             page = self._entries.get(digest)
             if page is None:
                 break

@@ -198,26 +198,7 @@ class Scheduler:
         # small prompts behind it progress without starving it.
         if self.waiting:
             head = self.waiting[0]
-            if (self.prefix_cache is not None and not head.prefix_checked
-                    and head.num_prefilled == 0 and not head.pages):
-                head.prefix_checked = True
-                # Prefix-cache reuse rides the chunked-prefill machinery: a
-                # cached page-aligned prefix becomes "already prefilled
-                # history" and only the tail is computed.
-                pages, matched = self.prefix_cache.lookup(head.all_token_ids)
-                # Always leave >=1 token to prefill (sampling reads the last
-                # prompt token's hidden state).
-                while matched >= head.num_tokens:
-                    self.allocator.free([pages.pop()])
-                    matched -= self.page_size
-                if matched > 0:
-                    head.pages = pages
-                    head.num_prefilled = matched
-                    logger.info("%s: prefix cache hit, %d/%d tokens reused",
-                                head.request_id, matched, head.num_tokens)
-                else:
-                    for p in pages:
-                        self.allocator.free([p])
+            self._try_prefix_reuse(head)
             if head.num_prefilled > 0 or head.num_tokens > self.max_prefill_tokens:
                 batch = self._schedule_chunk(head)
                 if batch is not None:
@@ -369,6 +350,24 @@ class Scheduler:
             logits_indices=logits_indices, page_tables=page_table,
             hist_len=hist_len, partial=partial,
             **self._sampling_arrays([seq], B))
+
+    def _try_prefix_reuse(self, seq: Sequence) -> None:
+        """Prefix-cache reuse rides the chunked-prefill machinery: a cached
+        page-aligned prefix becomes "already prefilled history" and only the
+        tail is computed. At most one lookup per (re)admission; the match is
+        capped to num_tokens-1 so >=1 token remains to prefill (sampling
+        reads the last prompt token's hidden state)."""
+        if (self.prefix_cache is None or seq.prefix_checked
+                or seq.num_prefilled > 0 or seq.pages):
+            return
+        seq.prefix_checked = True
+        pages, matched = self.prefix_cache.lookup(
+            seq.all_token_ids, max_tokens=seq.num_tokens - 1)
+        if matched > 0:
+            seq.pages = pages
+            seq.num_prefilled = matched
+            logger.info("%s: prefix cache hit, %d/%d tokens reused",
+                        seq.request_id, matched, seq.num_tokens)
 
     def _register_prefix(self, seq: Sequence) -> None:
         """Content-address this sequence's full PROMPT pages so later
