@@ -28,4 +28,4 @@ The node-level ops layer lives in the repo-root ``cluster/`` directory:
 ``cluster/device-plugin/`` (the C++ kubelet device plugin + DaemonSet).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
