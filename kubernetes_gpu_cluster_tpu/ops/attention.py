@@ -232,7 +232,13 @@ def paged_decode_attention_xla(
 # Dispatchers (Pallas on TPU, XLA elsewhere)
 # ---------------------------------------------------------------------------
 
-def ragged_prefill_attention(q, k, v, seg_ids, positions, scale, *, use_pallas=None):
+def ragged_prefill_attention(q, k, v, seg_ids, positions, scale, *,
+                             use_pallas=None, strict=False):
+    """``strict=True`` disables the XLA fallback: a kernel trace failure
+    propagates instead of being swallowed. The driver's compile check uses it
+    so a broken kernel fails the check rather than silently passing on the
+    fallback (the round-3 hole: NBUF NameError shipped because every caller
+    caught it)."""
     if use_pallas is None:
         use_pallas = _on_tpu()
     if use_pallas:
@@ -240,16 +246,19 @@ def ragged_prefill_attention(q, k, v, seg_ids, positions, scale, *, use_pallas=N
             from .pallas.flash_prefill import flash_ragged_prefill
             return flash_ragged_prefill(q, k, v, seg_ids, positions, scale)
         except Exception as e:  # pragma: no cover - fallback safety
+            if strict:
+                raise
             logger.warning("pallas prefill unavailable (%s); falling back to XLA", e)
     return ragged_prefill_attention_xla(q, k, v, seg_ids, positions, scale)
 
 
 def paged_decode_attention(q, k_cache_l, v_cache_l, page_tables, context_lens,
                            k_cur, v_cur, scale, *, layer=None,
-                           use_pallas=None):
+                           use_pallas=None, strict=False):
     """``layer`` (with a stacked [L, P, ps, n_kv*hd] pool) lets the Pallas
     kernel address the pool with a dynamic layer index instead of the caller
-    slicing a per-layer copy out — the zero-copy path the decode scan uses."""
+    slicing a per-layer copy out — the zero-copy path the decode scan uses.
+    ``strict=True``: no XLA fallback (see ragged_prefill_attention)."""
     if use_pallas is None:
         use_pallas = _on_tpu()
     if use_pallas:
@@ -259,6 +268,8 @@ def paged_decode_attention(q, k_cache_l, v_cache_l, page_tables, context_lens,
                                        context_lens, k_cur, v_cur, scale,
                                        layer=layer)
         except Exception as e:  # pragma: no cover - fallback safety
+            if strict:
+                raise
             logger.warning("pallas decode unavailable (%s); falling back to XLA", e)
     return paged_decode_attention_xla(q, k_cache_l, v_cache_l, page_tables,
                                       context_lens, k_cur, v_cur, scale,

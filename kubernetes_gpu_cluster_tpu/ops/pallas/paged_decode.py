@@ -56,9 +56,9 @@ def _decode_kernel(
     # output
     out_ref,           # [1, nh, hd] VMEM
     # scratch
-    k_buf,             # [2, C, ps, n_kv*hd] VMEM
-    v_buf,             # [2, C, ps, n_kv*hd]
-    sems,              # DMA sems [2, 2, C]
+    k_buf,             # [NBUF, C, ps, n_kv*hd] VMEM
+    v_buf,             # [NBUF, C, ps, n_kv*hd]
+    sems,              # DMA sems [NBUF, 2, C]
     *,
     scale: float,
     pages_per_seq: int,
@@ -67,7 +67,9 @@ def _decode_kernel(
     q_per_kv: int,
     head_dim: int,
     chunk_pages: int,
+    num_bufs: int,
 ):
+    NBUF = num_bufs
     b = pl.program_id(0)
     C = chunk_pages
     ps = page_size
@@ -182,7 +184,7 @@ def _decode_kernel(
 
 def pallas_paged_decode(q, k_pool, v_pool, page_tables, context_lens,
                         k_cur, v_cur, scale, *, layer=None, interpret=False,
-                        chunk_pages=None):
+                        chunk_pages=None, num_bufs=2):
     """q: [B, nh, hd]; k_pool/v_pool: [P, ps, n_kv*hd] (one layer, heads
     flattened) or [L, P, ps, n_kv*hd] with ``layer`` the dynamic layer index;
     page_tables: [B, pages_per_seq]; context_lens: [B] (incl. current token);
@@ -224,9 +226,14 @@ def pallas_paged_decode(q, k_pool, v_pool, page_tables, context_lens,
     k_cur = k_cur.reshape(B, 1, n_kv * hd)
     v_cur = v_cur.reshape(B, 1, n_kv * hd)
 
+    # Prefetch depth: with C pages in flight per buffer slot, NBUF slots keep
+    # NBUF*C page DMAs outstanding. Clamp to the worst-case chunk count —
+    # slots beyond ceil(pps/C) could never be in flight simultaneously and
+    # would only waste VMEM. num_bufs=1 is the serial (no-prefetch) baseline.
+    NBUF = max(1, min(int(num_bufs), -(-pps // C)))
     kernel = functools.partial(
         _decode_kernel, scale=float(scale), pages_per_seq=pps, page_size=ps,
-        num_kv=n_kv, q_per_kv=g, head_dim=hd, chunk_pages=C)
+        num_kv=n_kv, q_per_kv=g, head_dim=hd, chunk_pages=C, num_bufs=NBUF)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -244,9 +251,9 @@ def pallas_paged_decode(q, k_pool, v_pool, page_tables, context_lens,
         out_specs=pl.BlockSpec((1, nh, hd), lambda b, *_: (b, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, C, ps, n_kv * hd), k_pool.dtype),
-            pltpu.VMEM((2, C, ps, n_kv * hd), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((2, 2, C)),
+            pltpu.VMEM((NBUF, C, ps, n_kv * hd), k_pool.dtype),
+            pltpu.VMEM((NBUF, C, ps, n_kv * hd), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((NBUF, 2, C)),
         ],
     )
     return pl.pallas_call(
