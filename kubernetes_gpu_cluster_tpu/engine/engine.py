@@ -86,6 +86,7 @@ class LLMEngine:
         self.model_config = config.model
         self.eos_token_id = eos_token_id
         self.mesh = mesh
+        self.pp_size = mesh.shape.get("pp", 1) if mesh is not None else 1
         self.use_pallas = self._resolve_use_pallas(use_pallas)
         self._key = jax.random.key(config.seed)
 
@@ -103,7 +104,19 @@ class LLMEngine:
         self.scheduler = Scheduler(config, num_pages)
 
         kv_sharding = params_sharding = None
-        if mesh is not None:
+        if mesh is not None and self.pp_size > 1:
+            # Pipeline serving: params/KV live in the shard_map layout (layer
+            # axis over pp, Megatron tp inside stages) and every step runs the
+            # circular pipeline of parallel/pp.py. This is the engine-side
+            # integration the reference got from Ray + vLLM
+            # (pipelineParallelSize, reference values-01-minimal-example4.yaml:16-23).
+            from ..parallel.pp import (pp_kv_sharding, pp_param_shardings,
+                                       validate_pp_mesh)
+            validate_pp_mesh(mesh, config.model)
+            kv_sharding = pp_kv_sharding(mesh)
+            params_sharding = pp_param_shardings(mesh, config.model)
+            logger.info("pipeline-parallel serving: %s", dict(mesh.shape))
+        elif mesh is not None:
             from ..parallel.sharding import kv_cache_sharding, param_shardings
             kv_sharding = kv_cache_sharding(mesh, config.model)
             params_sharding = param_shardings(mesh, config.model)
@@ -118,7 +131,6 @@ class LLMEngine:
                                           kv_sharding)
 
         self._prefill_fn = self._build_prefill_fn()
-        self._prefill_hist_fn = self._build_prefill_hist_fn()
         # Two compiled window programs: all-greedy batches (the common
         # serving case) never trace sampling at all — argmax only. Selection
         # happens HOST-side per batch from its SamplingParams; a runtime
@@ -126,6 +138,12 @@ class LLMEngine:
         # program and its cost on the critical path.
         self._decode_fn = self._build_decode_fn(greedy=False)
         self._decode_fn_greedy = self._build_decode_fn(greedy=True)
+        # Chunked-prefill history attention has no pipelined variant yet:
+        # under pp it runs as plain GSPMD over the pp-sharded params (XLA
+        # gathers the layer stack — correct, slow, and rare: only prompts
+        # longer than max_prefill_tokens take this path; parity locked in by
+        # tests/test_parallel.py::test_pp_engine_chunked_prefill).
+        self._prefill_hist_fn = self._build_prefill_hist_fn()
         self.stats = EngineStats()
         self.step_count = 0
         # Speculative decode-window chain state (see step()).
@@ -209,17 +227,45 @@ class LLMEngine:
         host->device upload is a round trip on remote-attached TPUs, so the
         step interface is packed tight: int_t [4, T] (tokens, seg_ids,
         positions, slot_mapping), int_b [B, 2] (logits_indices, top_k),
-        float_b [B, 2] (temperature, top_p)."""
+        float_b [B, 2] (temperature, top_p).
+
+        Under a pp mesh the same interface runs the circular pipeline of
+        parallel/pp.py instead of the flat forward — the scheduler/step loop
+        is oblivious to pp."""
         cfg = self.model_config
         use_pallas = self.use_pallas
 
+        if self.pp_size > 1:
+            from ..parallel.pp import build_pp_mapped, pp_logits
+            mapped = build_pp_mapped(self.mesh, cfg, "prefill",
+                                     use_pallas=use_pallas)
+
+            def fwd(params, kv, int_t, logits_indices):
+                # The whole ragged prefill batch rides the pipeline as ONE
+                # microbatch (M=1): the scheduler packs sequences into a
+                # single flat [T] buffer, and splitting it would let a
+                # sequence straddle microbatches, breaking in-batch
+                # attention. S-1 bubble ticks per prefill is the cost;
+                # decode — the steady state — microbatches properly.
+                meta_mb = PrefillMeta(
+                    seg_ids=int_t[1][None], positions=int_t[2][None],
+                    slot_mapping=int_t[3][None],
+                    logits_indices=logits_indices[None])
+                hidden_mb, kvk, kvv = mapped(params, kv.k, kv.v,
+                                             int_t[0][None], meta_mb)
+                return (pp_logits(params, cfg, hidden_mb[0], logits_indices),
+                        KVCache(k=kvk, v=kvv))
+        else:
+            def fwd(params, kv, int_t, logits_indices):
+                meta = PrefillMeta(seg_ids=int_t[1], positions=int_t[2],
+                                   slot_mapping=int_t[3],
+                                   logits_indices=logits_indices)
+                hidden, kv, _ = model_lib.forward_prefill(
+                    params, cfg, int_t[0], meta, kv, use_pallas=use_pallas)
+                return model_lib.compute_logits(params, cfg, hidden), kv
+
         def prefill_step(params, kv: KVCache, int_t, int_b, float_b, key):
-            meta = PrefillMeta(seg_ids=int_t[1], positions=int_t[2],
-                               slot_mapping=int_t[3],
-                               logits_indices=int_b[:, 0])
-            hidden, kv, _ = model_lib.forward_prefill(
-                params, cfg, int_t[0], meta, kv, use_pallas=use_pallas)
-            logits = model_lib.compute_logits(params, cfg, hidden)
+            logits, kv = fwd(params, kv, int_t, int_b[:, 0])
             next_tokens = sample_tokens(logits, key, float_b[:, 0],
                                         int_b[:, 1], float_b[:, 1])
             return next_tokens, kv
@@ -263,6 +309,36 @@ class LLMEngine:
         ps = self.config.cache.page_size
         max_len = self.config.effective_max_len
 
+        if self.pp_size > 1:
+            from ..parallel.pp import build_pp_mapped, pp_logits
+            S = self.pp_size
+            mapped = build_pp_mapped(self.mesh, cfg, "decode",
+                                     use_pallas=use_pallas)
+
+            def fwd(params, kv, tokens, meta):
+                # Split the batch into M microbatches (M = pp when the padded
+                # batch divides evenly, else 1 — shapes are static per
+                # bucket, so M resolves at trace time); each substep runs the
+                # M+S-1-tick circular pipeline, and sampling happens outside
+                # the shard_map on the reassembled [B] hidden states.
+                B = tokens.shape[0]
+                M = S if B % S == 0 else 1
+                meta_mb = DecodeMeta(
+                    positions=meta.positions.reshape(M, B // M),
+                    slot_mapping=meta.slot_mapping.reshape(M, B // M),
+                    page_tables=meta.page_tables.reshape(M, B // M, -1),
+                    context_lens=meta.context_lens.reshape(M, B // M))
+                hidden_mb, kvk, kvv = mapped(params, kv.k, kv.v,
+                                             tokens.reshape(M, B // M),
+                                             meta_mb)
+                return (pp_logits(params, cfg, hidden_mb.reshape(B, -1)),
+                        KVCache(k=kvk, v=kvv))
+        else:
+            def fwd(params, kv, tokens, meta):
+                hidden, kv, _ = model_lib.forward_decode(
+                    params, cfg, tokens, meta, kv, use_pallas=use_pallas)
+                return model_lib.compute_logits(params, cfg, hidden), kv
+
         def decode_window(params, kv: KVCache, tokens0, int_b, float_b, key):
             # tokens0: [B] — separate so chained windows can feed the previous
             # window's device-resident output column without a host roundtrip.
@@ -292,9 +368,7 @@ class LLMEngine:
                                slot_mapping=slot,
                                page_tables=page_tables,
                                context_lens=pos_c + 1)
-                hidden, kv, _ = model_lib.forward_decode(
-                    params, cfg, tokens, m, kv, use_pallas=use_pallas)
-                logits = model_lib.compute_logits(params, cfg, hidden)
+                logits, kv = fwd(params, kv, tokens, m)
                 if greedy:
                     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 else:

@@ -101,6 +101,23 @@ def param_pp_specs(cfg: ModelConfig) -> dict:
 KV_PP_SPEC = P("pp", None, None, "tp")  # [L, P, ps, n_kv*hd], heads over tp
 
 
+def pp_param_shardings(mesh: Mesh, cfg: ModelConfig):
+    """NamedSharding pytree for engine-owned params under the pipeline mesh
+    (layer axis over ``pp``, Megatron tp inside stages). The engine places
+    params with these BEFORE stepping so the shard_map body never repartitions
+    weights. ``is_leaf`` guards PartitionSpec's tuple ancestry from tree
+    descent."""
+    from jax.sharding import NamedSharding
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        param_pp_specs(cfg),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def pp_kv_sharding(mesh: Mesh):
+    from jax.sharding import NamedSharding
+    return NamedSharding(mesh, KV_PP_SPEC)
+
+
 def validate_pp_mesh(mesh: Mesh, cfg: ModelConfig) -> None:
     S, tp, ep = mesh.shape["pp"], mesh.shape["tp"], mesh.shape["ep"]
     if cfg.num_layers % S != 0:
@@ -115,15 +132,12 @@ def validate_pp_mesh(mesh: Mesh, cfg: ModelConfig) -> None:
         raise ValueError(f"num_experts={cfg.num_experts} not divisible by ep={ep}")
 
 
-def build_pp_forward(mesh: Mesh, cfg: ModelConfig, kind: str, use_pallas=None):
-    """Build the pipelined forward.
-
-    Returns ``fn(params, kv, tokens_mb, meta_mb) -> (hidden_mb, new_kv)`` where
-    every meta field carries a leading microbatch axis ``[M, ...]`` and
-    ``hidden_mb`` is the raw last-stage hidden state ``[M, N, d]``
-    (N = flattened tokens T for prefill, batch B for decode). The caller
-    applies final-norm/logits/sampling (see :func:`pp_logits`).
-    """
+def build_pp_mapped(mesh: Mesh, cfg: ModelConfig, kind: str, use_pallas=None):
+    """The un-jitted shard_map pipeline: ``mapped(params, kv_k, kv_v,
+    tokens_mb, meta_mb) -> (hidden_mb [M, N, d], kv_k, kv_v)``. Composable
+    inside a larger jitted program — the engine's decode window wraps it in
+    its substep scan (sampling stays outside the shard_map, where params'
+    replicated final_norm/lm_head make logits a plain GSPMD matmul)."""
     assert kind in ("prefill", "decode")
     validate_pp_mesh(mesh, cfg)
     S = mesh.shape["pp"]
@@ -179,13 +193,24 @@ def build_pp_forward(mesh: Mesh, cfg: ModelConfig, kind: str, use_pallas=None):
         meta_specs = DecodeMeta(positions=P(), slot_mapping=P(),
                                 page_tables=P(), context_lens=P())
 
-    mapped = jax.shard_map(
+    return jax.shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(param_pp_specs(cfg), KV_PP_SPEC, KV_PP_SPEC, P(), meta_specs),
         out_specs=(P(), KV_PP_SPEC, KV_PP_SPEC),
         check_vma=False,
     )
+
+
+def build_pp_forward(mesh: Mesh, cfg: ModelConfig, kind: str, use_pallas=None):
+    """Jitted standalone pipelined forward: ``fn(params, kv, tokens_mb,
+    meta_mb) -> (hidden_mb, new_kv)`` where every meta field carries a leading
+    microbatch axis ``[M, ...]`` and ``hidden_mb`` is the raw last-stage
+    hidden state ``[M, N, d]`` (N = flattened tokens T for prefill, batch B
+    for decode). The caller applies final-norm/logits/sampling (see
+    :func:`pp_logits`). The serving engine uses :func:`build_pp_mapped`
+    directly instead, fusing sampling into its step program."""
+    mapped = build_pp_mapped(mesh, cfg, kind, use_pallas=use_pallas)
 
     @partial(jax.jit, donate_argnums=(1,))
     def fn(params, kv: KVCache, tokens_mb, meta_mb):
