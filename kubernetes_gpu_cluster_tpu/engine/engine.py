@@ -162,31 +162,40 @@ class LLMEngine:
             return False
         cfg = self.model_config
         tp = self.mesh.shape.get("tp", 1) if self.mesh is not None else 1
+        if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+            logger.warning(
+                "Pallas kernels disabled: heads (%d q / %d kv) not divisible "
+                "by tp=%d; using XLA attention", cfg.num_heads,
+                cfg.num_kv_heads, tp)
+            return False
         lane = (cfg.num_kv_heads * cfg.head_dim) // tp
         if lane % 128 != 0:
             logger.warning(
                 "Pallas kernels disabled: per-shard KV lane dim %d (n_kv*hd/tp)"
                 " is not 128-aligned; using XLA attention", lane)
             return False
-        if self.mesh is not None:
-            # pallas_call under GSPMD auto-partitioning is not supported for
-            # the paged pool layout; the sharded path uses XLA attention
-            # (shard_map-wrapped Pallas is the planned upgrade).
-            logger.warning("Pallas kernels disabled under GSPMD mesh; "
-                           "using XLA attention")
-            return False
-        return self._probe_pallas_compile()
+        # Under a mesh the kernels run per-shard inside shard_map — the tp
+        # wrappers (ops.attention.*_tp) for GSPMD serving, or the pipeline's
+        # own shard_map body for pp>1 — so the probe compiles the kernel at
+        # the PER-SHARD head geometry each device will actually build.
+        return self._probe_pallas_compile(tp)
 
-    def _probe_pallas_compile(self) -> bool:
-        """Compile one tiny decode-kernel call ON THE REAL CHIP before
+    def _probe_pallas_compile(self, tp: int = 1) -> bool:
+        """Compile one tiny call of EACH Pallas kernel ON THE REAL CHIP before
         committing to the Pallas path. Mosaic layout constraints surface only
         at jit-compile time (round-2 postmortem: the static lane check passed,
         the kernel did not compile, and the engine had no fallback), so the
-        only reliable gate is an actual compile at this model's head geometry.
-        ~1s for the tiny shapes; cached for the process lifetime."""
+        only reliable gate is an actual compile at this model's head geometry
+        (divided by tp: the per-shard geometry under a mesh). Both kernels
+        must pass: under a mesh the tp wrappers call them with no runtime
+        fallback, so a prefill-only Mosaic failure would otherwise crash the
+        first serving step. ~2s for the tiny shapes; cached per process."""
+        from ..ops.pallas.flash_prefill import flash_ragged_prefill
         from ..ops.pallas.paged_decode import pallas_paged_decode
 
         cfg = self.model_config
+        cfg = dataclasses.replace(cfg, num_heads=cfg.num_heads // tp,
+                                  num_kv_heads=cfg.num_kv_heads // tp)
         ps = self.config.cache.page_size
         # pps >= the kernel's DERIVED chunk_pages (max(1, 128 // page_size),
         # see pallas_paged_decode): the kernel caps its chunk at
@@ -196,21 +205,47 @@ class LLMEngine:
         # every page_size >= 16.
         B, pps = 4, 8
         kd = cfg.num_kv_heads * cfg.head_dim
+        scale = cfg.head_dim ** -0.5
         q = jnp.zeros((B, cfg.num_heads, cfg.head_dim), cfg.jnp_dtype)
-        pool = jnp.zeros((2, ps, kd), cfg.jnp_dtype)
+        # Stacked [L, P, ps, kd] pool + dynamic layer index — the variant
+        # serving actually runs (a flat layer=None probe would exercise a
+        # different addressing pattern than the decode scan's
+        # k_hbm.at[layer_ref[0], page]).
+        pool = jnp.zeros((2, 2, ps, kd), cfg.jnp_dtype)
         tables = jnp.zeros((B, pps), jnp.int32)
         ctx = jnp.ones((B,), jnp.int32)
         cur = jnp.zeros((B, cfg.num_kv_heads, cfg.head_dim), cfg.jnp_dtype)
         try:
             jax.jit(lambda *a: pallas_paged_decode(
-                *a, cfg.head_dim ** -0.5)).lower(
+                *a, scale, layer=jnp.zeros((1,), jnp.int32))).lower(
                     q, pool, pool, tables, ctx, cur, cur).compile()
         except Exception as e:  # Mosaic errors are plain XlaRuntimeError
             logger.warning(
                 "Pallas decode kernel failed probe compile (%s); "
                 "falling back to XLA attention", e)
             return False
+        T = 128
+        qf = jnp.zeros((T, cfg.num_heads, cfg.head_dim), cfg.jnp_dtype)
+        kf = jnp.zeros((T, cfg.num_kv_heads, cfg.head_dim), cfg.jnp_dtype)
+        seg = jnp.zeros((T,), jnp.int32)
+        pos = jnp.arange(T, dtype=jnp.int32)
+        try:
+            jax.jit(lambda *a: flash_ragged_prefill(*a, scale)).lower(
+                qf, kf, kf, seg, pos).compile()
+        except Exception as e:
+            logger.warning(
+                "Pallas prefill kernel failed probe compile (%s); "
+                "falling back to XLA attention", e)
+            return False
         return True
+
+    def _gspmd_attn_mesh(self):
+        """The mesh to run Pallas attention under (shard_map tp wrappers) in
+        GSPMD serving — None when the engine resolved to XLA attention or the
+        forward already runs inside the pipeline's shard_map."""
+        if self.mesh is not None and self.pp_size == 1 and self.use_pallas:
+            return self.mesh
+        return None
 
     # -- jitted step programs ----------------------------------------------
 
@@ -256,12 +291,15 @@ class LLMEngine:
                 return (pp_logits(params, cfg, hidden_mb[0], logits_indices),
                         KVCache(k=kvk, v=kvv))
         else:
+            attn_mesh = self._gspmd_attn_mesh()
+
             def fwd(params, kv, int_t, logits_indices):
                 meta = PrefillMeta(seg_ids=int_t[1], positions=int_t[2],
                                    slot_mapping=int_t[3],
                                    logits_indices=logits_indices)
                 hidden, kv, _ = model_lib.forward_prefill(
-                    params, cfg, int_t[0], meta, kv, use_pallas=use_pallas)
+                    params, cfg, int_t[0], meta, kv, use_pallas=use_pallas,
+                    attn_mesh=attn_mesh)
                 return model_lib.compute_logits(params, cfg, hidden), kv
 
         def prefill_step(params, kv: KVCache, int_t, int_b, float_b, key):
@@ -334,9 +372,12 @@ class LLMEngine:
                 return (pp_logits(params, cfg, hidden_mb.reshape(B, -1)),
                         KVCache(k=kvk, v=kvv))
         else:
+            attn_mesh = self._gspmd_attn_mesh()
+
             def fwd(params, kv, tokens, meta):
                 hidden, kv, _ = model_lib.forward_decode(
-                    params, cfg, tokens, meta, kv, use_pallas=use_pallas)
+                    params, cfg, tokens, meta, kv, use_pallas=use_pallas,
+                    attn_mesh=attn_mesh)
                 return model_lib.compute_logits(params, cfg, hidden), kv
 
         def decode_window(params, kv: KVCache, tokens0, int_b, float_b, key):

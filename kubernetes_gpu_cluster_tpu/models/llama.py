@@ -34,8 +34,10 @@ from ..ops.rope import apply_rope, rope_cos_sin
 from ..ops.attention import (
     write_kv_pages_all,
     ragged_prefill_attention,
+    ragged_prefill_attention_tp,
     prefill_history_attention_xla,
     paged_decode_attention,
+    paged_decode_attention_tp,
 )
 
 Params = dict[str, Any]
@@ -288,16 +290,23 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
                     layer_slice=None, use_pallas=None,
                     hidden_in: Optional[jax.Array] = None,
                     tp_axis: Optional[str] = None,
-                    ep_axis: Optional[str] = None):
+                    ep_axis: Optional[str] = None,
+                    attn_mesh=None):
     """Ragged prefill over T flattened tokens. Returns (selected_hidden [B, d],
     new_kv, raw_hidden [T, d]). ``hidden_in`` replaces the embedding lookup for
-    non-first pipeline stages; ``raw_hidden`` is what rotates stage-to-stage."""
+    non-first pipeline stages; ``raw_hidden`` is what rotates stage-to-stage.
+    ``attn_mesh``: under a GSPMD mesh, run the Pallas attention per-shard via
+    shard_map over the tp axis (ops.attention.ragged_prefill_attention_tp)."""
     scale = cfg.head_dim ** -0.5
     h = params["embed"][tokens] if hidden_in is None else hidden_in
 
     def attn_fn(lp, q, k, v, layer_idx):
         # Prefill attends within the in-batch k/v only (each sequence's whole
         # prompt is in this batch); the pool is written post-scan for decode.
+        if attn_mesh is not None:
+            return ragged_prefill_attention_tp(attn_mesh, q, k, v,
+                                               meta.seg_ids, meta.positions,
+                                               scale)
         return ragged_prefill_attention(q, k, v, meta.seg_ids, meta.positions,
                                         scale, use_pallas=use_pallas)
 
@@ -338,9 +347,12 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
                    layer_slice=None, use_pallas=None,
                    hidden_in: Optional[jax.Array] = None,
                    tp_axis: Optional[str] = None,
-                   ep_axis: Optional[str] = None):
+                   ep_axis: Optional[str] = None,
+                   attn_mesh=None):
     """Decode step: B sequences, one new token each, against the paged pool.
-    Returns (normed_hidden [B, d], new_kv, raw_hidden [B, d])."""
+    Returns (normed_hidden [B, d], new_kv, raw_hidden [B, d]).
+    ``attn_mesh``: under a GSPMD mesh, run the Pallas attention per-shard via
+    shard_map over the tp axis (ops.attention.paged_decode_attention_tp)."""
     scale = cfg.head_dim ** -0.5
     h = params["embed"][tokens] if hidden_in is None else hidden_in
 
@@ -353,6 +365,11 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
         # are committed to the pool in one post-scan scatter. The STACKED pool
         # + dynamic layer index go straight to the kernel — no per-layer pool
         # slice is ever materialized (see _layer_scan docstring).
+        if attn_mesh is not None:
+            return paged_decode_attention_tp(attn_mesh, q, kv.k, kv.v,
+                                             meta.page_tables,
+                                             meta.context_lens, k, v, scale,
+                                             layer=layer_idx)
         return paged_decode_attention(q, kv.k, kv.v, meta.page_tables,
                                       meta.context_lens, k, v, scale,
                                       layer=layer_idx, use_pallas=use_pallas)

@@ -103,3 +103,109 @@ class TestFlashPrefillKernel:
                                    block_q=16, block_k=16, interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
+
+
+class TestPallasUnderMesh:
+    """The shard_map tp wrappers (ops.attention.*_tp): kernel-under-mesh
+    semantics on the 8-device CPU mesh in interpret mode. The on-chip gate
+    for this path is the engine's per-shard probe compile
+    (LLMEngine._probe_pallas_compile(tp))."""
+
+    def test_paged_decode_tp_matches_oracle(self):
+        from kubernetes_gpu_cluster_tpu.ops.attention import (
+            paged_decode_attention_tp)
+        from kubernetes_gpu_cluster_tpu.parallel import make_mesh
+
+        mesh = make_mesh(tp=2, dp=4)
+        B, P, ps, nkv, nh, hd, pps, L = 4, 9, 8, 2, 4, 32, 3, 2
+        rng = np.random.default_rng(5)
+        q = jnp.asarray(rng.standard_normal((B, nh, hd)), jnp.float32)
+        pool_k = jnp.asarray(rng.standard_normal((L, P, ps, nkv * hd)), jnp.float32)
+        pool_v = jnp.asarray(rng.standard_normal((L, P, ps, nkv * hd)), jnp.float32)
+        k_cur = jnp.asarray(rng.standard_normal((B, nkv, hd)), jnp.float32)
+        v_cur = jnp.asarray(rng.standard_normal((B, nkv, hd)), jnp.float32)
+        pt = jnp.asarray(rng.permutation(np.arange(1, 1 + B * pps)).reshape(B, pps),
+                         jnp.int32)
+        cl = jnp.asarray([1, ps + 2, 2 * ps, 3], jnp.int32)
+        for layer in range(L):
+            ref = paged_decode_attention_xla(q, pool_k[layer], pool_v[layer],
+                                             pt, cl, k_cur, v_cur, 0.125)
+            got = paged_decode_attention_tp(mesh, q, pool_k, pool_v, pt, cl,
+                                            k_cur, v_cur, 0.125, layer=layer,
+                                            interpret=True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_flash_prefill_tp_matches_oracle(self):
+        from kubernetes_gpu_cluster_tpu.ops.attention import (
+            ragged_prefill_attention_tp)
+        from kubernetes_gpu_cluster_tpu.parallel import make_mesh
+
+        mesh = make_mesh(tp=2)
+        T, nh, nkv, hd = 64, 4, 2, 32
+        rng = np.random.default_rng(6)
+        q = jnp.asarray(rng.standard_normal((T, nh, hd)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((T, nkv, hd)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((T, nkv, hd)), jnp.float32)
+        seg = np.concatenate([np.full(30, 0), np.full(20, 1), np.full(14, -1)])
+        pos = np.concatenate([np.arange(30), np.arange(20), np.zeros(14)])
+        seg = jnp.asarray(seg, jnp.int32)
+        pos = jnp.asarray(pos, jnp.int32)
+        ref = ragged_prefill_attention_xla(q, k, v, seg, pos, 0.125)
+        got = ragged_prefill_attention_tp(mesh, q, k, v, seg, pos, 0.125,
+                                          interpret=True)
+        mask = np.asarray(seg) >= 0
+        np.testing.assert_allclose(np.asarray(got)[mask], np.asarray(ref)[mask],
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_engine_decode_via_attn_mesh(self):
+        """Full forward_decode with attn_mesh set (the engine's GSPMD + Pallas
+        path) must match the plain XLA forward. interpret-mode Pallas inside
+        the real model forward, under jit, on the tp=2 mesh."""
+        import functools
+
+        from kubernetes_gpu_cluster_tpu.config import (CacheConfig,
+                                                       get_model_config)
+        from kubernetes_gpu_cluster_tpu.engine.kv_cache import allocate_kv_cache
+        from kubernetes_gpu_cluster_tpu.models import llama as model_lib
+        from kubernetes_gpu_cluster_tpu.parallel import make_mesh
+        from kubernetes_gpu_cluster_tpu.parallel.sharding import (
+            kv_cache_sharding, param_shardings)
+        import kubernetes_gpu_cluster_tpu.ops.attention as attn
+
+        cfg = get_model_config("debug-tiny")
+        mesh = make_mesh(tp=2, dp=4)
+        params = model_lib.init_params(cfg, jax.random.key(0))
+        kv = allocate_kv_cache(cfg, CacheConfig(page_size=8, num_pages=17), 17)
+
+        B, pps = 2, 2
+        meta = model_lib.DecodeMeta(
+            positions=jnp.asarray([5, 3], jnp.int32),
+            slot_mapping=jnp.asarray([1 * 8 + 5, 3 * 8 + 3], jnp.int32),
+            page_tables=jnp.asarray([[1, 2], [3, 4]], jnp.int32),
+            context_lens=jnp.asarray([6, 4], jnp.int32))
+        tokens = jnp.asarray([7, 11], jnp.int32)
+
+        ref, _, _ = model_lib.forward_decode(params, cfg, tokens, meta, kv,
+                                             use_pallas=False)
+
+        # Route the tp wrapper's kernel through interpret mode (CPU mesh).
+        orig = attn.paged_decode_attention_tp
+        def tp_interp(mesh_, *a, **kw):
+            return orig(mesh_, *a, **{**kw, "interpret": True})
+        attn.paged_decode_attention_tp = tp_interp
+        model_lib.paged_decode_attention_tp = tp_interp
+        try:
+            sharded_params = jax.device_put(params, param_shardings(mesh, cfg))
+            sharded_kv = jax.tree.map(
+                functools.partial(jax.device_put,
+                                  device=kv_cache_sharding(mesh, cfg)), kv)
+            got, _, _ = jax.jit(
+                lambda p, k: model_lib.forward_decode(p, cfg, tokens, meta, k,
+                                                      attn_mesh=mesh)
+            )(sharded_params, sharded_kv)
+        finally:
+            attn.paged_decode_attention_tp = orig
+            model_lib.paged_decode_attention_tp = orig
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
