@@ -35,8 +35,15 @@ from kubernetes_gpu_cluster_tpu.config import (
     CacheConfig, EngineConfig, SchedulerConfig, get_model_config)
 from kubernetes_gpu_cluster_tpu.engine import LLMEngine, SamplingParams
 
-# Representative single-A100 vLLM decode throughput, ~1B-class model, batch 64.
-A100_VLLM_TOKS_PER_S = 6000.0
+# SELF-CHOSEN comparison bar, not a measured or published number: the
+# reference publishes no benchmarks, so vs_baseline normalizes against a
+# representative single-A100 vLLM decode throughput per model class (batch
+# ~64). Labeled as such in the output ("baseline_bar").
+A100_VLLM_TOKS_PER_S = {
+    "tinyllama-1.1b": 6000.0,   # ~1B class
+    "llama-3-8b": 1500.0,       # 8B class (BASELINE.json config 2)
+}
+DEFAULT_A100_BAR = 6000.0
 
 import os
 
@@ -65,10 +72,26 @@ def _add_batch(engine, rng, vocab, tag):
     return t
 
 
+def _measure_host_rt_s() -> float:
+    """Median host<->device round trip for a tiny dispatched op — on the
+    tunnel-attached bench chip this is ~110 ms and dominates TTFT; reported
+    separately so prefill compute is attributable."""
+    x = jax.numpy.zeros((1,), jax.numpy.float32)
+    f = jax.jit(lambda a: a + 1)
+    f(x).block_until_ready()  # compile outside the timing
+    ts = []
+    for _ in range(5):
+        t = time.perf_counter()
+        f(x).block_until_ready()
+        ts.append(time.perf_counter() - t)
+    return sorted(ts)[len(ts) // 2]
+
+
 def main() -> None:
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
-    model_name = "tinyllama-1.1b" if on_tpu else "debug-tiny"
+    model_name = os.environ.get(
+        "KGCT_BENCH_MODEL", "tinyllama-1.1b" if on_tpu else "debug-tiny")
     quant = os.environ.get("KGCT_BENCH_QUANT") or None
     page = PAGE if PAGE is not None else (128 if on_tpu else 16)
     pages_per_seq = (PROMPT_LEN + MAX_NEW_TOKENS) // page + 3
@@ -95,11 +118,14 @@ def main() -> None:
         engine.step()
 
     # --- measured fresh batch: prefill throughput + TTFT --------------------
+    host_rt_s = _measure_host_rt_s()
     t_submit = _add_batch(engine, rng, vocab, "bench")
     first_token_at: dict[str, float] = {}
+    prefill_steps = 0
     t0 = time.perf_counter()
     while engine.scheduler.waiting:
         outs = engine.step()
+        prefill_steps += 1
         now = time.perf_counter()
         for o in outs:
             if o.new_token_ids and o.request_id not in first_token_at:
@@ -124,15 +150,33 @@ def main() -> None:
     ttft_p50 = ttft[len(ttft) // 2] if ttft else float("nan")
     ttft_p95 = ttft[int(len(ttft) * 0.95)] if ttft else float("nan")
 
+    bar = A100_VLLM_TOKS_PER_S.get(model_name, DEFAULT_A100_BAR)
     result = {
         "metric": f"decode_tokens_per_sec_per_chip[{model_name},B={BATCH},ctx={PROMPT_LEN}]",
         "value": round(toks_per_s, 1),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(toks_per_s / A100_VLLM_TOKS_PER_S, 3),
+        "vs_baseline": round(toks_per_s / bar, 3),
         "backend": backend,
+        "quantization": quant,
         "prefill_tokens_per_sec": round(prefill_toks_per_s, 1),
         "ttft_p50_ms": round(ttft_p50 * 1e3, 1),
         "ttft_p95_ms": round(ttft_p95 * 1e3, 1),
+        # TTFT attribution: each engine prefill step pays one host<->device
+        # round trip (the bench chip is tunnel-attached, ~110 ms) on top of
+        # prefill compute; p50 TTFT ~= (steps_to_reach_p50_request) *
+        # (per-step compute + RT).
+        "ttft_breakdown": {
+            "host_rt_ms": round(host_rt_s * 1e3, 1),
+            "prefill_steps": prefill_steps,
+            "prefill_wall_ms": round(prefill_s * 1e3, 1),
+            "est_prefill_compute_ms": round(
+                max(prefill_s - prefill_steps * host_rt_s, 0.0) * 1e3, 1),
+        },
+        # vs_baseline is normalized against a SELF-CHOSEN constant (the
+        # reference publishes no numbers): representative single-A100 vLLM
+        # decode throughput for this model class.
+        "baseline_bar": {"value": bar,
+                         "source": "chosen constant (A100 vLLM class bar)"},
         "decode_window": DECODE_WINDOW,
     }
     print(json.dumps(result))

@@ -189,7 +189,8 @@ class LLMEngine:
         (divided by tp: the per-shard geometry under a mesh). Both kernels
         must pass: under a mesh the tp wrappers call them with no runtime
         fallback, so a prefill-only Mosaic failure would otherwise crash the
-        first serving step. ~2s for the tiny shapes; cached per process."""
+        first serving step. ~2s for the tiny shapes, paid once per engine
+        construction (serving builds one engine per process)."""
         from ..ops.pallas.flash_prefill import flash_ragged_prefill
         from ..ops.pallas.paged_decode import pallas_paged_decode
 

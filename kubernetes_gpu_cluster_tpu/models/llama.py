@@ -75,6 +75,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Optional[jnp.dtype] = N
     def w(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
 
+    if cfg.quantization == "int8":
+        return _init_params_int8(cfg, key, dtype, w)
+
     layers: Params = {
         "input_norm": jnp.ones((L, d), dtype),
         "post_attn_norm": jnp.ones((L, d), dtype),
@@ -110,6 +113,63 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Optional[jnp.dtype] = N
     if cfg.quantization:
         from ..ops.quant import quantize_params
         params = quantize_params(params, cfg.quantization)
+    return params
+
+
+def _init_params_int8(cfg: ModelConfig, key: jax.Array, dtype, w) -> Params:
+    """Random-init directly in the int8 layout (same pytree structure as
+    quantize_params output). Materializing the full bf16 model first and
+    quantizing after — the naive path — peaks at 2x the bf16 footprint, which
+    OOMs an 8B model on a 16 GB chip; random-init weights are synthetic
+    anyway (bench/tests), so the big matmul weights are drawn as int8
+    directly with a constant fan-in scale and nothing large ever exists in
+    bf16. Real checkpoints quantize tensor-by-tensor at load
+    (engine/weights.py)."""
+    d, L = cfg.hidden_size, cfg.num_layers
+    nh, nkv, hd, ff = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.intermediate_size
+    E = cfg.num_experts
+    keys = iter(jax.random.split(key, 24))
+
+    def wq8(key, shape, fan_in):
+        # dequant std ~= 73 * scale ~= 0.57 * fan_in^-0.5: same magnitude
+        # class as the bf16 init; quality is irrelevant for random weights.
+        q = jax.random.randint(key, shape, -127, 128, jnp.int8)
+        scale = jnp.full(shape[:-2] + shape[-1:], fan_in ** -0.5 / 127.0,
+                         jnp.float32)
+        return q, scale
+
+    layers: Params = {
+        "input_norm": jnp.ones((L, d), dtype),
+        "post_attn_norm": jnp.ones((L, d), dtype),
+    }
+    for name, shape, fan in (("wq", (L, d, nh * hd), d),
+                             ("wk", (L, d, nkv * hd), d),
+                             ("wv", (L, d, nkv * hd), d),
+                             ("wo", (L, nh * hd, d), nh * hd)):
+        layers[name], layers[name + "_scale"] = wq8(next(keys), shape, fan)
+    if cfg.attention_bias:
+        layers["bq"] = jnp.zeros((L, nh * hd), dtype)
+        layers["bk"] = jnp.zeros((L, nkv * hd), dtype)
+        layers["bv"] = jnp.zeros((L, nkv * hd), dtype)
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, hd), dtype)
+        layers["k_norm"] = jnp.ones((L, hd), dtype)
+    mlp_shapes = (("w_gate", (L, E, d, ff) if cfg.is_moe else (L, d, ff), d),
+                  ("w_up", (L, E, d, ff) if cfg.is_moe else (L, d, ff), d),
+                  ("w_down", (L, E, ff, d) if cfg.is_moe else (L, ff, d), ff))
+    if cfg.is_moe:
+        layers["router"] = w(next(keys), (L, d, E), d)
+    for name, shape, fan in mlp_shapes:
+        layers[name], layers[name + "_scale"] = wq8(next(keys), shape, fan)
+
+    params: Params = {
+        "embed": w(next(keys), (cfg.vocab_size, d), d),
+        "final_norm": jnp.ones((d,), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"], params["lm_head_scale"] = wq8(
+            next(keys), (d, cfg.vocab_size), d)
     return params
 
 
