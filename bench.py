@@ -41,9 +41,11 @@ from kubernetes_gpu_cluster_tpu.engine import LLMEngine, SamplingParams
 # ~64). Labeled as such in the output ("baseline_bar").
 A100_VLLM_TOKS_PER_S = {
     "tinyllama-1.1b": 6000.0,   # ~1B class
+    "debug-tiny": 6000.0,       # CPU smoke path, ~1B bar for continuity
     "llama-3-8b": 1500.0,       # 8B class (BASELINE.json config 2)
+    "llama-3-70b": 200.0,       # 70B class, per-chip share of an 8xA100 node
+    "mixtral-8x7b": 800.0,      # MoE 47B-total/13B-active class
 }
-DEFAULT_A100_BAR = 6000.0
 
 import os
 
@@ -150,12 +152,14 @@ def main() -> None:
     ttft_p50 = ttft[len(ttft) // 2] if ttft else float("nan")
     ttft_p95 = ttft[int(len(ttft) * 0.95)] if ttft else float("nan")
 
-    bar = A100_VLLM_TOKS_PER_S.get(model_name, DEFAULT_A100_BAR)
+    # No silent wrong-class comparison: a model without a defined bar gets
+    # vs_baseline null rather than a ~1B-class default.
+    bar = A100_VLLM_TOKS_PER_S.get(model_name)
     result = {
         "metric": f"decode_tokens_per_sec_per_chip[{model_name},B={BATCH},ctx={PROMPT_LEN}]",
         "value": round(toks_per_s, 1),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(toks_per_s / bar, 3),
+        "vs_baseline": round(toks_per_s / bar, 3) if bar else None,
         "backend": backend,
         "quantization": quant,
         "prefill_tokens_per_sec": round(prefill_toks_per_s, 1),
@@ -176,7 +180,8 @@ def main() -> None:
         # reference publishes no numbers): representative single-A100 vLLM
         # decode throughput for this model class.
         "baseline_bar": {"value": bar,
-                         "source": "chosen constant (A100 vLLM class bar)"},
+                         "source": ("chosen constant (A100 vLLM class bar)"
+                                    if bar else "no bar defined for model")},
         "decode_window": DECODE_WINDOW,
     }
     print(json.dumps(result))

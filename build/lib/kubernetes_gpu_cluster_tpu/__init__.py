@@ -1,0 +1,31 @@
+"""kubernetes_gpu_cluster_tpu — a TPU-native cluster + LLM-serving framework.
+
+A brand-new framework with the capabilities of the reference
+``alikhabazian/Kubernetes-gpu-cluster`` repo (a Kubernetes GPU cluster serving
+LLMs with vLLM), re-designed TPU-first:
+
+- The **serving engine** (continuous batching, paged KV cache, OpenAI API,
+  TP/PP/EP over ICI/DCN) is built in, in JAX/XLA/Pallas — the reference
+  delegated this to vLLM CUDA images (reference ``values-01-minimal-example*.yaml``).
+- The **cluster layer** (reset-first bootstrap, container runtime, kubeadm
+  init/join, HA control plane, accelerator enablement) targets TPU VM pods
+  (reference ``k8s_setup.sh``, ``gpu-crio-setup.sh``, ``multi-cp.md``).
+- The **deployment surface** keeps the reference's Helm
+  ``servingEngineSpec.modelSpec[]`` schema so operators can switch 1:1.
+
+Subpackages:
+    config    — typed config system (engine config + Helm-values-parity schema)
+    models    — model families (llama-class dense, mixtral-class MoE)
+    ops       — Pallas TPU kernels + XLA fallbacks (paged attention, ragged prefill)
+    engine    — paged KV cache, continuous-batching scheduler, LLMEngine
+    parallel  — mesh/sharding, TP/PP/EP/DP over ICI & DCN, jax.distributed bootstrap
+    serving   — OpenAI-compatible API server, router, tokenizer, metrics
+    deploy    — values-schema renderer emitting the k8s deployment manifests
+    utils     — logging, math helpers
+
+The node-level ops layer lives in the repo-root ``cluster/`` directory:
+``cluster/scripts/`` (reset-first bootstrap, runtime, proxy) and
+``cluster/device-plugin/`` (the C++ kubelet device plugin + DaemonSet).
+"""
+
+__version__ = "0.3.0"

@@ -1,0 +1,2 @@
+from .model_config import ModelConfig, MODEL_PRESETS, get_model_config  # noqa: F401
+from .engine_config import EngineConfig, CacheConfig, SchedulerConfig, ParallelConfig  # noqa: F401

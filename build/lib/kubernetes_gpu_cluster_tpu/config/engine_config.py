@@ -1,0 +1,88 @@
+"""Engine/runtime configuration.
+
+Field names deliberately mirror the operator-facing knobs of the reference's
+Helm values schema (``vllmConfig`` in ``values-01-minimal-example8.yaml:24-38``):
+``tensorParallelSize`` -> ParallelConfig.tp, ``pipelineParallelSize`` -> .pp,
+``gpuMemoryUtilization`` -> CacheConfig.hbm_utilization, ``maxModelLen`` ->
+EngineConfig.max_model_len — so the deployment surface
+(kubernetes_gpu_cluster_tpu.deploy.render) maps reference values files 1:1
+onto this engine; tests/test_deploy.py renders all nine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .model_config import ModelConfig, get_model_config
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    """Paged KV cache sizing (reference knob: gpuMemoryUtilization 0.90-0.99,
+    maxModelLen 128-4096 — values-01-minimal-example4.yaml:19-22, ...8.yaml:26-27)."""
+    # Tokens per KV page. None = backend-derived at engine init: 128 on TPU
+    # (the decode kernel then streams one page per DMA chunk — fewest DMA
+    # issues, measured fastest), 16 elsewhere (finest pool granularity for
+    # small test pools). Set explicitly to pin it.
+    page_size: Optional[int] = None
+    num_pages: Optional[int] = None    # explicit page count; None = derive from HBM
+    hbm_utilization: float = 0.90      # fraction of free HBM to give the KV cache
+    dtype: Optional[str] = None        # KV dtype; None = model dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    """Continuous-batching scheduler limits (the hot loop the reference only
+    shaped indirectly via maxModelLen / gpuMemoryUtilization, SURVEY §3.4)."""
+    max_num_seqs: int = 64             # max sequences resident per step
+    max_prefill_tokens: int = 2048     # token budget per prefill step
+    # Shape bucketing to keep the XLA jit cache small: decode batch sizes and
+    # prefill token counts are padded up to these buckets.
+    decode_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
+    prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048)
+    # Multi-step decode: run this many autoregressive decode steps inside one
+    # XLA program (sampled tokens feed back on-device via lax.scan), so host
+    # round-trips happen once per window, not once per token. Stop conditions
+    # are checked on the host after each window; tokens generated past a stop
+    # are discarded.
+    decode_window: int = 8
+    # Automatic prefix caching (vLLM enablePrefixCaching parity): completed
+    # prompts' full KV pages are content-addressed and reused by later
+    # requests sharing a page-aligned prefix (engine/kv_cache.PrefixCache).
+    enable_prefix_caching: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Device-mesh axes. TP rides ICI within a slice; PP/DP may cross hosts
+    over DCN (replaces the reference's NCCL TP + Ray PP,
+    values-01-minimal-example8.yaml:37-38 and ...4.yaml:18)."""
+    tp: int = 1    # tensor parallel (attention heads / MLP shards)
+    pp: int = 1    # pipeline parallel (layer stages)
+    dp: int = 1    # data parallel (replicated engine)
+    ep: int = 1    # expert parallel (MoE experts)
+
+    @property
+    def world_size(self) -> int:
+        return self.tp * self.pp * self.dp * self.ep
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    model: ModelConfig
+    cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
+    scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    max_model_len: Optional[int] = None  # override model.max_model_len
+    seed: int = 0
+    enforce_eager: bool = False          # parity with vllm --enforce-eager: disable
+                                         # jit caching (debug only; always slower)
+
+    @property
+    def effective_max_len(self) -> int:
+        return self.max_model_len or self.model.max_model_len
+
+    @staticmethod
+    def from_model_name(name: str, **kw) -> "EngineConfig":
+        return EngineConfig(model=get_model_config(name), **kw)

@@ -1,0 +1,13 @@
+"""Deployment surface: reference values schema -> Kubernetes manifests.
+
+The reference's user-facing artifact is `helm install vllm/vllm-stack -f
+values.yaml` driven by ``servingEngineSpec.modelSpec[]`` (reference
+``values-01-minimal-example*.yaml``, ``old_README.md:1079-1082``). This
+package is the TPU-native equivalent: :mod:`render` ingests that exact
+values schema and emits Deployment/StatefulSet/Service/router manifests that
+run THIS framework's serving engine on TPU nodes (``google.com/tpu``
+resources from cluster/device-plugin instead of ``nvidia.com/gpu``;
+``jax.distributed`` coordinator instead of ``raySpec`` Ray clusters).
+"""
+
+from .render import render_values, render_values_file  # noqa: F401

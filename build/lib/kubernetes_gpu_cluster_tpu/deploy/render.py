@@ -1,0 +1,333 @@
+"""Render the reference's Helm-values schema into TPU-native k8s manifests.
+
+Input schema (kept field-for-field compatible with the reference so its nine
+values files work unmodified — ``values-01-minimal-example8.yaml:6-62`` is
+the fullest example):
+
+    servingEngineSpec:
+      runtimeClassName: "crun"          # passthrough
+      modelSpec:
+        - name, repository, tag, imagePullPolicy
+          modelURL                      # HF id, preset name, or local path
+          replicaCount
+          requestCPU / requestMemory / requestGPU   # GPU count -> TPU chips
+          vllmConfig: {tensorParallelSize, pipelineParallelSize,
+                       gpuMemoryUtilization, maxModelLen, extraArgs}
+          env / shmSize / extraVolumes / extraVolumeMounts
+          nodeSelector / affinity / topologySpreadConstraints / tolerations
+          raySpec: {headNode: {...}}    # -> jax.distributed StatefulSet
+      routerSpec: {replicaCount, servicePort}       # optional
+
+Mapping decisions (TPU-first, not a vLLM translation):
+
+- ``requestGPU`` becomes ``google.com/tpu`` (advertised by
+  cluster/device-plugin); the count is also the default tensor-parallel size
+  when vllmConfig does not pin one, matching how the reference used N GPUs
+  with ``--tensor-parallel-size N``.
+- ``vllmConfig`` maps onto this framework's engine CLI
+  (serving/api_server.py): tensorParallelSize -> --tensor-parallel-size,
+  pipelineParallelSize -> --pipeline-parallel-size, gpuMemoryUtilization ->
+  --hbm-utilization, maxModelLen -> --max-model-len; extraArgs pass through
+  verbatim (unknown vLLM flags are rejected by the CLI rather than silently
+  dropped).
+- ``raySpec`` (the reference's cross-node PP vehicle, KubeRay head/workers —
+  ``old_README.md:1570-1625``) renders as a StatefulSet + headless Service:
+  stable pod DNS replaces the Ray head address, ``KGCT_COORDINATOR`` points
+  every rank at pod 0, and jax.distributed over ICI/DCN replaces the Ray
+  object/RPC layer. World size = pipelineParallelSize.
+- A router Deployment/Service fronts all model Deployments
+  (serving/router.py), playing vllm-router-service's role
+  (``old_README.md:1174-1176``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Optional
+
+import yaml
+
+DEFAULT_IMAGE = "ghcr.io/kgct/tpu-serving:v0.3.0"
+ENGINE_PORT = 8000
+ROUTER_PORT = 8080
+COORD_PORT = 8476
+
+_PART_OF = "kgct-stack"
+
+
+def _labels(name: str, component: str) -> dict:
+    return {
+        "app.kubernetes.io/name": _PART_OF,
+        "app.kubernetes.io/component": component,
+        "app.kubernetes.io/instance": name,
+    }
+
+
+def _engine_args(spec: dict) -> list[str]:
+    cfg = spec.get("vllmConfig") or {}
+    args = ["--model", str(spec["modelURL"]),
+            "--port", str(ENGINE_PORT)]
+    tp = cfg.get("tensorParallelSize")
+    pp = cfg.get("pipelineParallelSize")
+    if tp is None and spec.get("requestGPU", 1) > 1:
+        # The reference ran N GPUs as TP=N; N chips per pod default the same
+        # way (with PP, each rank still tensor-shards its own chips —
+        # otherwise all but one chip per pod would sit idle).
+        tp = spec["requestGPU"]
+    if tp is not None:
+        args += ["--tensor-parallel-size", str(tp)]
+    if pp is not None:
+        args += ["--pipeline-parallel-size", str(pp)]
+    if cfg.get("gpuMemoryUtilization") is not None:
+        args += ["--hbm-utilization", str(cfg["gpuMemoryUtilization"])]
+    if cfg.get("maxModelLen") is not None:
+        args += ["--max-model-len", str(cfg["maxModelLen"])]
+    if cfg.get("enablePrefixCaching"):
+        args += ["--enable-prefix-caching"]
+    # enableChunkedPrefill needs no flag: long prompts always chunk here.
+    if os.path.isabs(str(spec["modelURL"])):
+        # Local checkpoint dir (hostPath-mounted): weights + tokenizer live
+        # there (reference local-model story, values-…3.yaml:22-30).
+        args += ["--weights", str(spec["modelURL"]),
+                 "--tokenizer", str(spec["modelURL"])]
+    args += [str(a) for a in cfg.get("extraArgs") or []]
+    return args
+
+
+def _pod_spec(spec: dict, engine: dict, multihost: bool) -> dict:
+    name = spec["name"]
+    tpus = int(spec.get("requestGPU", 0) or 0)
+    resources: dict[str, Any] = {"requests": {}, "limits": {}}
+    if spec.get("requestCPU") is not None:
+        resources["requests"]["cpu"] = spec["requestCPU"]
+    if spec.get("requestMemory"):
+        resources["requests"]["memory"] = spec["requestMemory"]
+        resources["limits"]["memory"] = spec["requestMemory"]
+    if tpus:
+        resources["requests"]["google.com/tpu"] = tpus
+        resources["limits"]["google.com/tpu"] = tpus
+
+    volumes = list(spec.get("extraVolumes") or [])
+    mounts = list(spec.get("extraVolumeMounts") or [])
+    if spec.get("shmSize"):
+        # Parity knob: jax workers use shm for host staging buffers too.
+        if not any(v.get("name") == "dshm" for v in volumes):
+            volumes.append({"name": "dshm",
+                            "emptyDir": {"medium": "Memory",
+                                         "sizeLimit": spec["shmSize"]}})
+            mounts.append({"name": "dshm", "mountPath": "/dev/shm"})
+
+    env = list(spec.get("env") or [])
+    if multihost:
+        pp = (spec.get("vllmConfig") or {}).get("pipelineParallelSize", 1)
+        env += [
+            {"name": "KGCT_COORDINATOR",
+             "value": f"kgct-{name}-engine-0.kgct-{name}-engine-hl:{COORD_PORT}"},
+            {"name": "KGCT_NUM_PROCESSES", "value": str(pp)},
+            {"name": "KGCT_PROCESS_ID",
+             "valueFrom": {"fieldRef": {
+                 "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}}},
+        ]
+
+    container = {
+        "name": "serving-engine",
+        "image": engine["image"],
+        "imagePullPolicy": spec.get("imagePullPolicy", "IfNotPresent"),
+        "command": ["python", "-m",
+                    "kubernetes_gpu_cluster_tpu.serving.api_server"],
+        "args": _engine_args(spec) + (["--distributed"] if multihost else []),
+        "ports": [{"containerPort": ENGINE_PORT, "name": "http"}],
+        "resources": resources,
+        "readinessProbe": {
+            "httpGet": {"path": "/health", "port": ENGINE_PORT},
+            "initialDelaySeconds": 10, "periodSeconds": 5},
+        "livenessProbe": {
+            "httpGet": {"path": "/health", "port": ENGINE_PORT},
+            "initialDelaySeconds": 120, "periodSeconds": 10,
+            "failureThreshold": 6},
+    }
+    if env:
+        container["env"] = env
+    if mounts:
+        container["volumeMounts"] = mounts
+
+    pod: dict[str, Any] = {"containers": [container]}
+    if volumes:
+        pod["volumes"] = volumes
+    if engine.get("runtimeClassName"):
+        pod["runtimeClassName"] = engine["runtimeClassName"]
+    for key in ("nodeSelector", "affinity", "topologySpreadConstraints",
+                "tolerations"):
+        if spec.get(key):
+            pod[key] = spec[key]
+    return pod
+
+
+def _render_model(spec: dict, engine: dict) -> dict[str, dict]:
+    """One modelSpec entry -> its manifests {filename: manifest}."""
+    name = spec["name"]
+    cfg = spec.get("vllmConfig") or {}
+    multihost = bool(spec.get("raySpec")) or cfg.get("pipelineParallelSize", 1) > 1
+    labels = _labels(name, "serving-engine")
+    sel = {"matchLabels": labels}
+    meta = {"name": f"kgct-{name}-engine", "labels": labels}
+    pod = {"metadata": {"labels": labels},
+           "spec": _pod_spec(spec, engine, multihost)}
+    out: dict[str, dict] = {}
+
+    if multihost:
+        # Stable DNS identities for jax.distributed ranks (the reference
+        # used a Ray head + KubeRay for this role).
+        out[f"{name}-engine-statefulset.yaml"] = {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": meta,
+            "spec": {
+                "serviceName": f"kgct-{name}-engine-hl",
+                "replicas": cfg.get("pipelineParallelSize", 1),
+                "podManagementPolicy": "Parallel",
+                "selector": sel,
+                "template": pod,
+            },
+        }
+        out[f"{name}-engine-headless-svc.yaml"] = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": f"kgct-{name}-engine-hl", "labels": labels},
+            "spec": {
+                "clusterIP": "None",
+                "selector": labels,
+                "ports": [
+                    {"name": "http", "port": ENGINE_PORT},
+                    {"name": "coordinator", "port": COORD_PORT},
+                ],
+            },
+        }
+    else:
+        out[f"{name}-engine-deployment.yaml"] = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": meta,
+            "spec": {
+                "replicas": spec.get("replicaCount", 1),
+                "selector": sel,
+                "template": pod,
+            },
+        }
+    # Multihost: client traffic must land on rank 0 ONLY — it drives the
+    # jitted step over the global mesh; a request served by a peer rank would
+    # enter collectives the other ranks never join and hang the process
+    # group. The pod-index label (set by the StatefulSet controller) pins the
+    # Service to rank 0.
+    svc_selector = dict(labels)
+    if multihost:
+        svc_selector["apps.kubernetes.io/pod-index"] = "0"
+    out[f"{name}-engine-svc.yaml"] = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": f"kgct-{name}-engine-svc", "labels": labels},
+        "spec": {
+            "selector": svc_selector,
+            "ports": [{"name": "http", "port": ENGINE_PORT,
+                       "targetPort": ENGINE_PORT}],
+        },
+    }
+    return out
+
+
+def _render_router(model_names: list[str], router_spec: dict) -> dict[str, dict]:
+    labels = _labels("router", "router")
+    replicas = ",".join(
+        f"http://kgct-{n}-engine-svc:{ENGINE_PORT}" for n in model_names)
+    return {
+        "router-deployment.yaml": {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "kgct-router", "labels": labels},
+            "spec": {
+                "replicas": router_spec.get("replicaCount", 1),
+                "selector": {"matchLabels": labels},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {"containers": [{
+                        "name": "router",
+                        "image": router_spec.get("image", DEFAULT_IMAGE),
+                        "command": ["python", "-m",
+                                    "kubernetes_gpu_cluster_tpu.serving.router"],
+                        "args": ["--replicas", replicas,
+                                 "--port", str(ROUTER_PORT)],
+                        "ports": [{"containerPort": ROUTER_PORT}],
+                        "readinessProbe": {
+                            "httpGet": {"path": "/health",
+                                        "port": ROUTER_PORT},
+                            "periodSeconds": 5},
+                    }]},
+                },
+            },
+        },
+        # The service the reference port-forwarded (old_README.md:1472-1476):
+        # kubectl port-forward svc/kgct-router-service 30080:80
+        "router-svc.yaml": {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": "kgct-router-service",
+                         "labels": labels},
+            "spec": {
+                "selector": labels,
+                "ports": [{"name": "http",
+                           "port": router_spec.get("servicePort", 80),
+                           "targetPort": ROUTER_PORT}],
+            },
+        },
+    }
+
+
+def render_values(values: dict) -> dict[str, dict]:
+    """values dict (reference schema) -> {filename: k8s manifest dict}."""
+    engine_spec = values.get("servingEngineSpec") or {}
+    specs = engine_spec.get("modelSpec") or []
+    if not specs:
+        raise ValueError("servingEngineSpec.modelSpec is empty")
+    engine = {
+        "image": engine_spec.get("image", DEFAULT_IMAGE),
+        "runtimeClassName": engine_spec.get("runtimeClassName") or None,
+    }
+    out: dict[str, dict] = {}
+    for spec in specs:
+        if not spec.get("name"):
+            raise ValueError("modelSpec entry missing 'name'")
+        out.update(_render_model(spec, engine))
+    out.update(_render_router([s["name"] for s in specs],
+                              values.get("routerSpec") or {}))
+    return out
+
+
+def render_values_file(path: str) -> dict[str, dict]:
+    with open(path) as f:
+        return render_values(yaml.safe_load(f))
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """CLI: python -m kubernetes_gpu_cluster_tpu.deploy.render
+    -f values.yaml -o manifests/   (then: kubectl apply -f manifests/)"""
+    p = argparse.ArgumentParser()
+    p.add_argument("-f", "--values", required=True)
+    p.add_argument("-o", "--out-dir", default=None,
+                   help="write one YAML per manifest; default: print stream")
+    args = p.parse_args(argv)
+    manifests = render_values_file(args.values)
+    if args.out_dir:
+        os.makedirs(args.out_dir, exist_ok=True)
+        for fname, manifest in sorted(manifests.items()):
+            with open(os.path.join(args.out_dir, fname), "w") as f:
+                yaml.safe_dump(manifest, f, sort_keys=False)
+        print(f"wrote {len(manifests)} manifests to {args.out_dir}")
+    else:
+        docs = [yaml.safe_dump(m, sort_keys=False)
+                for _, m in sorted(manifests.items())]
+        print("---\n".join(docs), end="")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,447 @@
+"""Continuous-batching scheduler.
+
+The serving hot loop the reference only shaped via ``gpuMemoryUtilization`` /
+``maxModelLen`` knobs (SURVEY §3.4 "HOT LOOP (external, in vLLM)") is native
+here. vLLM-v0-style policy:
+
+- Prefills are prioritized: waiting sequences are admitted (FCFS) up to a token
+  budget and batched into one ragged prefill step.
+- Otherwise all running sequences take one decode step.
+- Under KV-page pressure the youngest running sequence is preempted by
+  recompute (pages freed, sequence returns to the waiting queue) — the
+  engine-level analogue of the reference's reset-then-converge recovery
+  property (SURVEY §1 L1).
+
+Shape discipline: every batch is padded to bucketed shapes (batch size, token
+count, pages-per-seq) so the number of distinct XLA compilations is small and
+bounded — this is what keeps continuous batching recompilation-storm-free
+under jit (SURVEY §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..config import EngineConfig
+from ..utils import cdiv, get_logger
+from ..utils.math import next_power_of_2
+from .kv_cache import CachingPageAllocator, PageAllocator
+from .sequence import FinishReason, Sequence, SequenceStatus
+
+logger = get_logger("scheduler")
+
+
+@dataclasses.dataclass
+class ScheduledBatch:
+    """One device step's worth of work, already laid out as padded numpy
+    arrays matching models.PrefillMeta / models.DecodeMeta."""
+    kind: str                      # "prefill" | "decode"
+    seqs: list[Sequence]           # the B real sequences (unpadded count)
+    tokens: np.ndarray             # prefill: [T]; decode: [B_pad]
+    positions: np.ndarray
+    slot_mapping: np.ndarray
+    # prefill only
+    seg_ids: Optional[np.ndarray] = None
+    logits_indices: Optional[np.ndarray] = None   # [B_pad]
+    # decode only
+    page_tables: Optional[np.ndarray] = None      # [B_pad, pages_bucket]
+    context_lens: Optional[np.ndarray] = None     # [B_pad]
+    # chunked prefill only (solo batch): history length + this seq's pages
+    # (in page_tables [1, pages_bucket]); partial = prompt not yet complete
+    # after this chunk (the sampled token is discarded).
+    hist_len: Optional[int] = None
+    partial: bool = False
+    # sampling arrays [B_pad]
+    temperature: Optional[np.ndarray] = None
+    top_k: Optional[np.ndarray] = None
+    top_p: Optional[np.ndarray] = None
+
+    @property
+    def num_seqs(self) -> int:
+        return len(self.seqs)
+
+
+def _bucket(value: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if value <= b:
+            return b
+    return next_power_of_2(value)
+
+
+class Scheduler:
+    def __init__(self, config: EngineConfig, num_pages: int):
+        self.config = config
+        sc = config.scheduler
+        self.max_num_seqs = sc.max_num_seqs
+        self.max_prefill_tokens = sc.max_prefill_tokens
+        self.decode_buckets = sc.decode_buckets
+        self.prefill_buckets = sc.prefill_buckets
+        self.page_size = config.cache.page_size
+        if sc.enable_prefix_caching:
+            self.allocator = CachingPageAllocator(num_pages, self.page_size)
+            self.prefix_cache = self.allocator.prefix_cache
+        else:
+            self.allocator = PageAllocator(num_pages, self.page_size)
+            self.prefix_cache = None
+        self.waiting: deque[Sequence] = deque()
+        self.running: list[Sequence] = []
+        # Sequences terminated by the scheduler itself (grown past pool
+        # capacity) — the engine drains these into RequestOutputs so a client
+        # waiting on the request still sees a finished event.
+        self.terminally_finished: list[Sequence] = []
+        # Monotone high-water marks for padded shapes (stats/debug).
+        self.num_preemptions = 0
+
+    # -- queue management ---------------------------------------------------
+
+    def add(self, seq: Sequence) -> None:
+        if seq.num_prompt_tokens == 0:
+            raise ValueError("prompt must contain at least one token")
+        # Prompts longer than the prefill token budget are CHUNKED across
+        # steps (vLLM chunked prefill); the model length cap still applies.
+        max_prompt = self.config.effective_max_len - 1
+        if seq.num_prompt_tokens > max_prompt:
+            raise ValueError(
+                f"prompt of {seq.num_prompt_tokens} tokens exceeds limit {max_prompt}")
+        # A prompt that cannot fit the page pool even when it is empty would
+        # never become schedulable — reject it up front instead of spinning.
+        usable_pages = self.allocator.num_pages - 1  # page 0 is scrap
+        need = cdiv(seq.num_prompt_tokens, self.page_size)
+        if need > usable_pages:
+            raise ValueError(
+                f"prompt needs {need} KV pages but the pool has {usable_pages}")
+        self.waiting.append(seq)
+
+    def abort(self, request_id: str) -> bool:
+        for seq in list(self.waiting):
+            if seq.request_id == request_id:
+                self.waiting.remove(seq)
+                seq.status = SequenceStatus.FINISHED
+                seq.finish_reason = FinishReason.ABORT
+                self._release(seq)   # mid-chunk prefills hold pages
+                return True
+        for seq in self.running:
+            if seq.request_id == request_id:
+                self.running.remove(seq)
+                seq.status = SequenceStatus.FINISHED
+                seq.finish_reason = FinishReason.ABORT
+                self._release(seq)
+                return True
+        return False
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def _release(self, seq: Sequence) -> None:
+        if seq.pages:
+            self.allocator.free(seq.pages)
+            seq.pages = []
+
+    def finish(self, seq: Sequence, reason) -> None:
+        seq.status = SequenceStatus.FINISHED
+        seq.finish_reason = reason
+        self._release(seq)
+        if seq in self.running:
+            self.running.remove(seq)
+
+    def _preempt_youngest(self) -> bool:
+        """Evict the most recently admitted running sequence (recompute-style
+        preemption). Returns False if nothing can be preempted."""
+        if not self.running:
+            return False
+        victim = self.running.pop()  # admission order => last is youngest
+        self._release(victim)
+        victim.status = SequenceStatus.PREEMPTED
+        victim.num_prefilled = 0     # pages gone: chunk progress recomputes
+        victim.prefix_checked = False  # re-lookup on readmission (cheap TTFT
+                                       # recovery when the prefix is cached)
+        # Recompute-style preemption: pages are gone; on readmission the
+        # prefill replays all_token_ids (prompt + generated so far) so the
+        # prompt/output split — and with it max_tokens accounting — is kept.
+        # INVARIANT: a mid-chunk sequence (holding pages) is only ever at
+        # waiting[0] — chunk scheduling runs on the head alone, so displacing
+        # it would strand its pages forever. Preempted victims slot in behind.
+        if self.waiting and self.waiting[0].num_prefilled > 0:
+            self.waiting.insert(1, victim)
+        else:
+            self.waiting.appendleft(victim)
+        self.num_preemptions += 1
+        logger.warning("preempted %s (KV pages exhausted; free=%d)",
+                       victim.request_id, self.allocator.num_free)
+        return True
+
+    # -- scheduling ---------------------------------------------------------
+
+    def schedule(self) -> Optional[ScheduledBatch]:
+        batch = self._schedule_prefills()
+        if batch is not None:
+            return batch
+        return self._schedule_decode()
+
+    # Bounded lookahead past a blocked queue head: fills the batch with
+    # later sequences that DO fit (no reordering — skipped sequences keep
+    # their place, so the head still goes first next round). Kills the
+    # head-of-line blocking where one large prompt stalled every small one
+    # behind it, while the bound prevents unbounded queue scans.
+    PREFILL_LOOKAHEAD = 8
+
+    def _schedule_prefills(self) -> Optional[ScheduledBatch]:
+        # A sequence larger than the prefill token budget streams through in
+        # chunks, admitted solo (its chunk attends to its pool history).
+        # When the chunk is BLOCKED (no pages / batch full), fall through to
+        # lookahead admission — the head keeps first claim on freed pages
+        # (this branch runs before any admission on every schedule call), so
+        # small prompts behind it progress without starving it.
+        if self.waiting:
+            head = self.waiting[0]
+            self._try_prefix_reuse(head)
+            if head.num_prefilled > 0 or head.num_tokens > self.max_prefill_tokens:
+                batch = self._schedule_chunk(head)
+                if batch is not None:
+                    return batch
+
+        admitted: list[Sequence] = []
+        total_tokens = 0
+        skipped = 0
+        i = 0
+        while i < len(self.waiting) and skipped <= self.PREFILL_LOOKAHEAD:
+            seq = self.waiting[i]
+            if len(self.running) + len(admitted) >= self.max_num_seqs:
+                break
+            if seq.num_tokens > self.max_prefill_tokens:
+                # Chunkable sequence mid-queue: solo-only, skip for this batch.
+                skipped += 1
+                i += 1
+                continue
+            fits_budget = (not admitted or
+                           total_tokens + seq.num_tokens <= self.max_prefill_tokens)
+            need = cdiv(seq.num_tokens, self.page_size)
+            # Budget first: can_allocate may EVICT prefix-cache entries to
+            # satisfy the probe, which must not happen for candidates the
+            # token budget rejects anyway.
+            fits_pages = fits_budget and self.allocator.can_allocate(need)
+            if not fits_pages and i == 0 and not self.running and not admitted:
+                # Pool is empty and the head still doesn't fit: it has grown
+                # (via preempt-recompute) past total capacity and can never be
+                # scheduled — terminate it at capacity.
+                self.waiting.popleft()
+                self._release(seq)
+                seq.status = SequenceStatus.FINISHED
+                seq.finish_reason = FinishReason.LENGTH
+                self.terminally_finished.append(seq)
+                logger.warning(
+                    "%s needs %d pages > pool capacity %d; finishing at "
+                    "length %d", seq.request_id, need,
+                    self.allocator.num_pages - 1, seq.num_tokens)
+                continue
+            if not (fits_budget and fits_pages):
+                # Never preempt running sequences to admit waiting ones — the
+                # victim would re-enter the waiting queue ahead of this
+                # sequence and immediately re-take the freed pages, churning
+                # full-recompute prefills while starving decode.
+                skipped += 1
+                i += 1
+                continue
+            seq.pages = self.allocator.allocate(need)
+            del self.waiting[i]
+            admitted.append(seq)
+            total_tokens += seq.num_tokens
+            self._register_prefix(seq)
+        if not admitted:
+            return None
+
+        T = _bucket(total_tokens, self.prefill_buckets)
+        B = _bucket(len(admitted), self.decode_buckets)
+        tokens = np.zeros(T, np.int32)
+        seg_ids = np.full(T, -1, np.int32)
+        positions = np.zeros(T, np.int32)
+        slot_mapping = np.zeros(T, np.int32)   # scrap page slots for padding
+        logits_indices = np.zeros(B, np.int32)
+        i = 0
+        for s, seq in enumerate(admitted):
+            n = seq.num_tokens
+            tokens[i:i + n] = seq.all_token_ids
+            seg_ids[i:i + n] = s
+            positions[i:i + n] = np.arange(n)
+            page_arr = np.asarray(seq.pages, np.int64)
+            tok_pos = np.arange(n)
+            slot_mapping[i:i + n] = (page_arr[tok_pos // self.page_size] *
+                                     self.page_size + tok_pos % self.page_size)
+            i += n
+            logits_indices[s] = i - 1
+            seq.status = SequenceStatus.RUNNING
+            self.running.append(seq)
+
+        return ScheduledBatch(
+            kind="prefill", seqs=admitted, tokens=tokens, positions=positions,
+            slot_mapping=slot_mapping, seg_ids=seg_ids,
+            logits_indices=logits_indices, **self._sampling_arrays(admitted, B))
+
+    def _schedule_chunk(self, seq: Sequence) -> Optional[ScheduledBatch]:
+        """One chunk of a long prompt, admitted solo: tokens
+        [num_prefilled, num_prefilled + chunk) run as a prefill attending to
+        the sequence's committed pool history. On the final chunk the
+        sequence joins running (its sampled token is the first generation);
+        earlier chunks leave it at the queue head with progress advanced."""
+        remaining = seq.num_tokens - seq.num_prefilled
+        chunk = min(remaining, self.max_prefill_tokens)
+        if len(self.running) >= self.max_num_seqs:
+            return None
+        end = seq.num_prefilled + chunk
+        need = cdiv(end, self.page_size) - len(seq.pages)
+        if need > 0 and not self.allocator.can_allocate(need):
+            usable = self.allocator.num_pages - 1
+            if not self.running and cdiv(end, self.page_size) > usable:
+                # Can never fit even an empty pool: capacity-terminate.
+                self.waiting.popleft()
+                self._release(seq)
+                seq.status = SequenceStatus.FINISHED
+                seq.finish_reason = FinishReason.LENGTH
+                self.terminally_finished.append(seq)
+                logger.warning("%s chunked prefill exceeds pool capacity "
+                               "(%d pages); finishing", seq.request_id, usable)
+            return None        # wait for decode finishes to free pages
+        if need > 0:
+            seq.pages.extend(self.allocator.allocate(need))
+
+        partial = end < seq.num_tokens
+        T = _bucket(chunk, self.prefill_buckets)
+        tokens = np.zeros(T, np.int32)
+        seg_ids = np.full(T, -1, np.int32)
+        positions = np.zeros(T, np.int32)
+        slot_mapping = np.zeros(T, np.int32)
+        tokens[:chunk] = seq.all_token_ids[seq.num_prefilled:end]
+        seg_ids[:chunk] = 0
+        tok_pos = np.arange(seq.num_prefilled, end)
+        positions[:chunk] = tok_pos
+        page_arr = np.asarray(seq.pages, np.int64)
+        slot_mapping[:chunk] = (page_arr[tok_pos // self.page_size] *
+                                self.page_size + tok_pos % self.page_size)
+        # History table width buckets to the ACTUAL context (few power-of-2
+        # compile shapes), not the model cap — the attention materializes
+        # [heads, T, width*ps] scores, so a max-len-wide table would make
+        # every small chunk pay max-model-len memory/FLOPs.
+        max_pages = cdiv(self.config.effective_max_len, self.page_size)
+        width = min(next_power_of_2(max(len(seq.pages), 1)), max_pages)
+        page_table = np.zeros((1, width), np.int32)
+        page_table[0, :len(seq.pages)] = seq.pages
+        B = _bucket(1, self.decode_buckets)
+        logits_indices = np.zeros(B, np.int32)
+        logits_indices[0] = chunk - 1
+
+        hist_len = seq.num_prefilled
+        seq.num_prefilled = end
+        if partial:
+            logger.info("%s prefill chunk [%d:%d) of %d", seq.request_id,
+                        hist_len, end, seq.num_tokens)
+        else:
+            self.waiting.popleft()
+            seq.status = SequenceStatus.RUNNING
+            self.running.append(seq)
+            self._register_prefix(seq)
+
+        return ScheduledBatch(
+            kind="prefill", seqs=[seq], tokens=tokens, positions=positions,
+            slot_mapping=slot_mapping, seg_ids=seg_ids,
+            logits_indices=logits_indices, page_tables=page_table,
+            hist_len=hist_len, partial=partial,
+            **self._sampling_arrays([seq], B))
+
+    def _try_prefix_reuse(self, seq: Sequence) -> None:
+        """Prefix-cache reuse rides the chunked-prefill machinery: a cached
+        page-aligned prefix becomes "already prefilled history" and only the
+        tail is computed. At most one lookup per (re)admission; the match is
+        capped to num_tokens-1 so >=1 token remains to prefill (sampling
+        reads the last prompt token's hidden state)."""
+        if (self.prefix_cache is None or seq.prefix_checked
+                or seq.num_prefilled > 0 or seq.pages):
+            return
+        seq.prefix_checked = True
+        pages, matched = self.prefix_cache.lookup(
+            seq.all_token_ids, max_tokens=seq.num_tokens - 1)
+        if matched > 0:
+            seq.pages = pages
+            seq.num_prefilled = matched
+            logger.info("%s: prefix cache hit, %d/%d tokens reused",
+                        seq.request_id, matched, seq.num_tokens)
+
+    def _register_prefix(self, seq: Sequence) -> None:
+        """Content-address this sequence's full PROMPT pages so later
+        requests sharing the prefix reuse them. Called at prompt-prefill
+        scheduling time — the KV is committed before any later schedule()
+        can hand the pages to another request (single-threaded step loop)."""
+        if self.prefix_cache is None:
+            return
+        full = seq.num_prompt_tokens // self.page_size
+        if full:
+            self.prefix_cache.register(seq.prompt_token_ids,
+                                       seq.pages[:full])
+
+    def _schedule_decode(self) -> Optional[ScheduledBatch]:
+        if not self.running:
+            return None
+        # Ensure every running seq has pages covering the whole multi-step
+        # decode window (the device writes W new KV entries before the host
+        # sees any token); preempt the youngest until the rest fit.
+        W = self.config.scheduler.decode_window
+        scheduled: list[Sequence] = []
+        idx = 0
+        while idx < len(self.running):
+            seq = self.running[idx]
+            # Window inputs occupy positions num_tokens-1 .. num_tokens+W-2;
+            # clamp to the model length cap (host truncates past-stop tokens).
+            last_pos = min(seq.num_tokens + W - 2, self.config.effective_max_len - 1)
+            pages_needed = cdiv(last_pos + 1, self.page_size)
+            grow = pages_needed - len(seq.pages)
+            if grow > 0:
+                if self.allocator.can_allocate(grow):
+                    seq.pages.extend(self.allocator.allocate(grow))
+                else:
+                    if not self._preempt_youngest():
+                        break
+                    continue  # retry same index (list shrank from the back)
+            scheduled.append(seq)
+            idx += 1
+        if not scheduled:
+            return None
+
+        B = _bucket(len(scheduled), self.decode_buckets)
+        # Static page-table width: sized for max_model_len once, so the jitted
+        # decode program never recompiles as contexts grow. Costless on the
+        # device side — the Pallas decode kernel streams only the valid pages;
+        # the table upload is B * pages_max * 4 bytes.
+        pages_bucket = cdiv(self.config.effective_max_len, self.page_size)
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        slot_mapping = np.zeros(B, np.int32)
+        page_tables = np.zeros((B, pages_bucket), np.int32)
+        context_lens = np.zeros(B, np.int32)
+        for s, seq in enumerate(scheduled):
+            last = (seq.output_token_ids[-1] if seq.output_token_ids
+                    else seq.prompt_token_ids[-1])
+            pos = seq.num_tokens - 1
+            tokens[s] = last
+            positions[s] = pos
+            slot_mapping[s] = (seq.pages[pos // self.page_size] * self.page_size
+                               + pos % self.page_size)
+            page_tables[s, :len(seq.pages)] = seq.pages
+            context_lens[s] = seq.num_tokens
+
+        return ScheduledBatch(
+            kind="decode", seqs=scheduled, tokens=tokens, positions=positions,
+            slot_mapping=slot_mapping, page_tables=page_tables,
+            context_lens=context_lens, **self._sampling_arrays(scheduled, B))
+
+    def _sampling_arrays(self, seqs: list[Sequence], B: int) -> dict:
+        temperature = np.zeros(B, np.float32)   # padding rows sample greedily
+        top_k = np.zeros(B, np.int32)
+        top_p = np.ones(B, np.float32)
+        for s, seq in enumerate(seqs):
+            temperature[s] = seq.params.temperature
+            top_k[s] = seq.params.top_k
+            top_p[s] = seq.params.top_p
+        return dict(temperature=temperature, top_k=top_k, top_p=top_p)

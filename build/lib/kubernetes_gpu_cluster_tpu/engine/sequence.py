@@ -1,0 +1,95 @@
+"""Sequence state tracked by the continuous-batching scheduler."""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Optional
+
+from .sampling_params import SamplingParams
+
+
+class SequenceStatus(enum.Enum):
+    WAITING = "waiting"        # queued, no KV pages yet
+    RUNNING = "running"        # resident in the batch
+    PREEMPTED = "preempted"    # evicted under memory pressure; will recompute
+    FINISHED = "finished"
+
+
+class FinishReason(enum.Enum):
+    STOP = "stop"              # hit EOS / stop token
+    LENGTH = "length"          # hit max_tokens or max_model_len
+    ABORT = "abort"            # client cancelled
+
+
+class Sequence:
+    """One request's generation state. Pages are owned by the scheduler's
+    PageAllocator; this object just records which pages back it."""
+
+    def __init__(self, request_id: str, prompt_token_ids: list[int],
+                 params: SamplingParams, eos_token_id: Optional[int] = None):
+        self.request_id = request_id
+        self.prompt_token_ids = list(prompt_token_ids)
+        self.output_token_ids: list[int] = []
+        self.params = params
+        self.eos_token_id = eos_token_id
+        self.status = SequenceStatus.WAITING
+        self.finish_reason: Optional[FinishReason] = None
+        self.pages: list[int] = []
+        self.arrival_time = time.monotonic()
+        self.first_token_time: Optional[float] = None  # for TTFT metrics
+        # Chunked prefill progress: tokens whose KV is already committed to
+        # the pool by earlier chunks. Reset on preemption (pages are freed,
+        # the prompt recomputes from scratch).
+        self.num_prefilled = 0
+        # Prefix-cache lookup done (one per (re)admission — a blocked head is
+        # rescheduled many times and must not re-hash/re-fork per call).
+        self.prefix_checked = False
+
+    @property
+    def all_token_ids(self) -> list[int]:
+        """Prompt + generated tokens — everything whose KV must be resident.
+        This is what a recompute-prefill replays after preemption."""
+        return self.prompt_token_ids + self.output_token_ids
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def num_output_tokens(self) -> int:
+        return len(self.output_token_ids)
+
+    @property
+    def num_tokens(self) -> int:
+        return self.num_prompt_tokens + self.num_output_tokens
+
+    @property
+    def is_finished(self) -> bool:
+        return self.status == SequenceStatus.FINISHED
+
+    def append_token(self, token_id: int) -> None:
+        if self.first_token_time is None:
+            self.first_token_time = time.monotonic()
+        self.output_token_ids.append(token_id)
+
+    def check_stop(self, max_model_len: int) -> Optional[FinishReason]:
+        """Token-level stop conditions (string-level stops are handled by the
+        server layer which owns the tokenizer)."""
+        if not self.output_token_ids:
+            return None
+        last = self.output_token_ids[-1]
+        if not self.params.ignore_eos and self.eos_token_id is not None \
+                and last == self.eos_token_id:
+            return FinishReason.STOP
+        if last in self.params.stop_token_ids:
+            return FinishReason.STOP
+        if self.num_output_tokens >= self.params.max_tokens:
+            return FinishReason.LENGTH
+        if self.num_tokens >= max_model_len:
+            return FinishReason.LENGTH
+        return None
+
+    def __repr__(self):
+        return (f"Sequence({self.request_id}, status={self.status.value}, "
+                f"prompt={self.num_prompt_tokens}, out={self.num_output_tokens})")

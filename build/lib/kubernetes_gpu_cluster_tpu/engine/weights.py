@@ -1,0 +1,231 @@
+"""Weight loading: local HF safetensors checkpoints -> stacked params pytree.
+
+The reference pre-staged model weights on every node and mounted them via
+hostPath (``old_README.md:1482-1561``, ``values-01-minimal-example3.yaml:22-30``)
+— the same zero-egress deployment story applies here: weights are read from a
+LOCAL directory (git-lfs clone / rsync, as the reference did), never
+downloaded at serving time.
+
+Mapping: HF per-layer tensors (torch ``[out, in]`` convention) are transposed
+to our right-multiply ``[in, out]`` layout and STACKED along a leading [L]
+axis to match models/llama.py's scanned-layer params. Families covered match
+config/model_config.py: llama-class (Llama 1/2/3, TinyLlama), Qwen2/2.5
+(attention bias), Qwen3 (qk-norm, tied embeddings), Mixtral (MoE experts).
+
+Memory discipline: tensors are read lazily from the safetensors mmap and
+written straight into preallocated per-parameter numpy buffers, so peak host
+memory is ~one copy of the model (required for 8B on a 16G host; 70B loads
+are expected to run sharded, one host per PP stage / TP shard via
+``shardings``, where jax.device_put uploads only the addressable shards).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..config.model_config import MODEL_PRESETS
+from ..utils import get_logger
+
+logger = get_logger("engine.weights")
+
+Params = dict[str, Any]
+
+
+def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
+    """Build a ModelConfig from a local HF checkpoint's config.json — any
+    llama/qwen2/qwen3/mixtral-architecture model works without a preset."""
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    num_heads = hf["num_attention_heads"]
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
+    rope_scaling = None
+    if hf.get("rope_scaling"):
+        from ..ops.rope import scaled_inv_freq
+        raw = {k: v for k, v in hf["rope_scaling"].items()
+               if isinstance(v, (str, int, float, bool))}
+        # Validate NOW — an unsupported type (yarn, dynamic, ...) must fail
+        # the load, not silently serve with unscaled RoPE.
+        scaled_inv_freq(head_dim, float(hf.get("rope_theta", 10000.0)), raw)
+        rope_scaling = tuple(sorted(raw.items()))
+    return ModelConfig(
+        name=name or os.path.basename(os.path.normpath(path)),
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=num_heads,
+        num_kv_heads=hf.get("num_key_value_heads", num_heads),
+        head_dim=head_dim,
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        attention_bias=bool(hf.get("attention_bias",
+                                   arch == "Qwen2ForCausalLM")),
+        qk_norm=arch == "Qwen3ForCausalLM",
+        num_experts=hf.get("num_local_experts", 0),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        max_model_len=min(int(hf.get("max_position_embeddings", 4096)), 8192),
+    )
+
+
+class _Checkpoint:
+    """All *.safetensors files of a checkpoint dir behind one name->tensor
+    lookup (lazy: tensors are materialized per get())."""
+
+    def __init__(self, path: str):
+        from safetensors import safe_open
+
+        self._handles = []
+        self._index: dict[str, int] = {}
+        files = sorted(f for f in os.listdir(path)
+                       if f.endswith(".safetensors"))
+        if not files:
+            raise FileNotFoundError(f"no *.safetensors under {path}")
+        for f in files:
+            h = safe_open(os.path.join(path, f), framework="np")
+            i = len(self._handles)
+            self._handles.append(h)
+            for key in h.keys():
+                self._index[key] = i
+        logger.info("checkpoint %s: %d files, %d tensors", path, len(files),
+                    len(self._index))
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._index
+
+    def get(self, key: str) -> np.ndarray:
+        arr = self._handles[self._index[key]].get_tensor(key)
+        if arr.dtype == np.dtype("V2"):   # raw bf16 comes back as void16
+            arr = arr.view(jnp.bfloat16)
+        return arr
+
+    def get_t(self, key: str) -> np.ndarray:
+        """Fetch a torch [out, in] matrix as [in, out]."""
+        return np.ascontiguousarray(self.get(key).T)
+
+
+def load_weights(path: str, cfg: ModelConfig,
+                 shardings: Optional[Any] = None,
+                 dtype: Optional[jnp.dtype] = None) -> Params:
+    """Load a local HF checkpoint into the stacked-layer params pytree of
+    models/llama.py. ``shardings`` is an optional matching pytree of
+    NamedShardings (parallel.sharding.param_shardings) — with it, each
+    parameter is placed sharded (jax.device_put with a sharding uploads only
+    the addressable shards)."""
+    ckpt = _Checkpoint(path)
+    dtype = dtype or cfg.jnp_dtype
+    L = cfg.num_layers
+
+    def stack(keys_fn, transpose=True) -> np.ndarray:
+        """Stack per-layer tensors into one [L, ...] array without holding
+        more than one extra layer copy."""
+        first = ckpt.get_t(keys_fn(0)) if transpose else ckpt.get(keys_fn(0))
+        out = np.empty((L,) + first.shape, dtype=first.dtype)
+        out[0] = first
+        for l in range(1, L):
+            out[l] = ckpt.get_t(keys_fn(l)) if transpose else ckpt.get(keys_fn(l))
+        return out
+
+    pre = "model.layers.{}."
+    layers: Params = {
+        "input_norm": stack(lambda l: pre.format(l) + "input_layernorm.weight",
+                            transpose=False),
+        "post_attn_norm": stack(
+            lambda l: pre.format(l) + "post_attention_layernorm.weight",
+            transpose=False),
+        "wq": stack(lambda l: pre.format(l) + "self_attn.q_proj.weight"),
+        "wk": stack(lambda l: pre.format(l) + "self_attn.k_proj.weight"),
+        "wv": stack(lambda l: pre.format(l) + "self_attn.v_proj.weight"),
+        "wo": stack(lambda l: pre.format(l) + "self_attn.o_proj.weight"),
+    }
+    if cfg.attention_bias:
+        for ours, theirs in (("bq", "q_proj"), ("bk", "k_proj"),
+                             ("bv", "v_proj")):
+            layers[ours] = stack(
+                lambda l, t=theirs: pre.format(l) + f"self_attn.{t}.bias",
+                transpose=False)
+    if cfg.qk_norm:
+        layers["q_norm"] = stack(
+            lambda l: pre.format(l) + "self_attn.q_norm.weight", transpose=False)
+        layers["k_norm"] = stack(
+            lambda l: pre.format(l) + "self_attn.k_norm.weight", transpose=False)
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers["router"] = stack(
+            lambda l: pre.format(l) + "block_sparse_moe.gate.weight")
+
+        def stack_experts(w_name: str) -> np.ndarray:
+            first = ckpt.get_t(
+                pre.format(0) + f"block_sparse_moe.experts.0.{w_name}.weight")
+            out = np.empty((L, E) + first.shape, dtype=first.dtype)
+            for l in range(L):
+                for e in range(E):
+                    out[l, e] = ckpt.get_t(
+                        pre.format(l)
+                        + f"block_sparse_moe.experts.{e}.{w_name}.weight")
+            return out
+
+        layers["w_gate"] = stack_experts("w1")
+        layers["w_up"] = stack_experts("w3")
+        layers["w_down"] = stack_experts("w2")
+    else:
+        layers["w_gate"] = stack(lambda l: pre.format(l) + "mlp.gate_proj.weight")
+        layers["w_up"] = stack(lambda l: pre.format(l) + "mlp.up_proj.weight")
+        layers["w_down"] = stack(lambda l: pre.format(l) + "mlp.down_proj.weight")
+
+    params: Params = {
+        "embed": ckpt.get("model.embed_tokens.weight"),
+        "final_norm": ckpt.get("model.norm.weight"),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        if "lm_head.weight" in ckpt:
+            params["lm_head"] = ckpt.get_t("lm_head.weight")
+        else:   # checkpoint ties even though config doesn't say so
+            params["lm_head"] = np.ascontiguousarray(params["embed"].T)
+
+    if cfg.quantization:
+        # Host-side (numpy) so the device never sees the full-precision
+        # weights; the int8 tensors upload at half the bytes.
+        from ..ops.quant import quantize_params
+        params = quantize_params(params, cfg.quantization)
+
+    def put(path_, x):
+        name = path_[-1].key if hasattr(path_[-1], "key") else str(path_[-1])
+        if x.dtype == np.int8 or name.endswith("_scale"):
+            x = jnp.asarray(x)          # int8 weights / f32 scales as-is
+        else:
+            x = jnp.asarray(x, dtype=dtype)
+        if shardings is not None:
+            s = shardings
+            for k in path_:
+                s = s[k.key] if hasattr(k, "key") else s[k]
+            return jax.device_put(x, s)
+        return jax.device_put(x)
+
+    out = jax.tree_util.tree_map_with_path(put, params)
+    n_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(out))
+    logger.info("loaded %s: %.2f GB as %s", cfg.name, n_bytes / 1e9, dtype)
+    return out
+
+
+def resolve_model(model_url: str, name: Optional[str] = None):
+    """The reference's ``modelURL`` semantics (HF id OR local path,
+    ``values-01-minimal-example3.yaml:8,22-30``): a local directory with
+    config.json -> (config_from_hf, weights+tokenizer from it); otherwise a
+    preset name -> (preset config, random init, byte tokenizer)."""
+    if os.path.isdir(model_url) and os.path.exists(
+            os.path.join(model_url, "config.json")):
+        cfg = config_from_hf(model_url, name)
+        return cfg, model_url, model_url
+    from ..config import get_model_config
+    return get_model_config(model_url), None, None

@@ -1,0 +1,9 @@
+from .llama import (  # noqa: F401
+    PrefillMeta,
+    DecodeMeta,
+    init_params,
+    forward_prefill,
+    forward_decode,
+    compute_logits,
+)
+from .registry import get_model_config  # noqa: F401

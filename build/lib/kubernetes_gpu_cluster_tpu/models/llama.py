@@ -67,20 +67,16 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Optional[jnp.dtype] = N
     """Random-init params (bench/tests; real weights come from engine.weights).
     Layout: stacked [L, ...] per-layer tensors + embed/final_norm/lm_head."""
     dtype = dtype or cfg.jnp_dtype
-
-    def w(key, shape, fan_in):
-        return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
-
-    if cfg.quantization is not None:
-        if cfg.quantization != "int8":
-            raise ValueError(
-                f"unsupported quantization {cfg.quantization!r} (int8)")
-        return _init_params_int8(cfg, key, dtype, w)
-
     d, L = cfg.hidden_size, cfg.num_layers
     nh, nkv, hd, ff = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.intermediate_size
     E = cfg.num_experts
     keys = iter(jax.random.split(key, 16))
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
+
+    if cfg.quantization == "int8":
+        return _init_params_int8(cfg, key, dtype, w)
 
     layers: Params = {
         "input_norm": jnp.ones((L, d), dtype),
@@ -114,6 +110,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Optional[jnp.dtype] = N
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = w(next(keys), (d, cfg.vocab_size), d)
+    if cfg.quantization:
+        from ..ops.quant import quantize_params
+        params = quantize_params(params, cfg.quantization)
     return params
 
 

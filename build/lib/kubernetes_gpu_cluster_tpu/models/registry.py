@@ -1,0 +1,49 @@
+"""Model registry: the reference's ``modelURL`` semantics, natively.
+
+A modelSpec's ``modelURL`` is either an HF-style id mapped to a preset, or a
+local checkpoint directory pre-staged on the node (the reference staged
+models to ``/models/<name>`` on every node and hostPath-mounted them,
+``old_README.md:1482-1561``, ``values-01-minimal-example3.yaml:8,22-30``).
+``resolve()`` turns that one string into everything the engine needs: an
+architecture config, a weights source, and a tokenizer source. All families
+share one decoder implementation (models/llama.py), specialized purely by
+ModelConfig.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..config.model_config import MODEL_PRESETS, ModelConfig, get_model_config  # noqa: F401
+
+
+@dataclasses.dataclass(frozen=True)
+class ResolvedModel:
+    config: ModelConfig
+    weights_path: Optional[str]     # None -> random init (debug/bench)
+    tokenizer_path: Optional[str]   # None -> byte tokenizer
+
+
+def resolve(model_url: str, name: Optional[str] = None) -> ResolvedModel:
+    """modelURL (HF id, preset name, or local checkpoint dir) -> ResolvedModel."""
+    from ..engine.weights import resolve_model
+
+    cfg, weights, tokenizer = resolve_model(model_url, name)
+    return ResolvedModel(config=cfg, weights_path=weights,
+                         tokenizer_path=tokenizer)
+
+
+def load(resolved: ResolvedModel, shardings=None):
+    """Materialize params for a resolved model: real weights when staged,
+    None (engine random-init) otherwise."""
+    if resolved.weights_path is not None:
+        from ..engine.weights import load_weights
+
+        return load_weights(resolved.weights_path, resolved.config,
+                            shardings=shardings)
+    return None
+
+
+def list_models() -> list[str]:
+    return sorted(MODEL_PRESETS)

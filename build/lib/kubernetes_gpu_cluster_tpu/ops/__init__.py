@@ -1,0 +1,7 @@
+from .rope import apply_rope, rope_cos_sin  # noqa: F401
+from .attention import (  # noqa: F401
+    write_kv_pages_all,
+    paged_decode_attention,
+    ragged_prefill_attention,
+)
+from .sampling import sample_tokens  # noqa: F401

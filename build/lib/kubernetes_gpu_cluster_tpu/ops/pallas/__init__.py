@@ -1,0 +1,21 @@
+"""Pallas/Mosaic TPU kernels for the serving hot loop.
+
+The north-star requirement (BASELINE.json): "PagedAttention and
+ragged-prefill rewritten as Pallas/XLA custom-calls". These kernels replace
+the reference's vLLM CUDA PagedAttention (the engine inside the images that
+reference ``values-01-minimal-example*.yaml`` deploy):
+
+- paged_decode.py — decode attention streaming only the valid KV pages
+  HBM->VMEM with double-buffered DMA and online softmax (the XLA fallback
+  gathers the full padded page table instead).
+- flash_prefill.py — ragged (segment-causal) flash attention for prefill,
+  O(T) memory (the XLA fallback materializes the O(T^2) score matrix).
+
+Both are numerically validated against the XLA reference implementations in
+tests/test_pallas.py (interpret mode on CPU; compiled on real TPU).
+"""
+
+from .paged_decode import pallas_paged_decode
+from .flash_prefill import flash_ragged_prefill
+
+__all__ = ["pallas_paged_decode", "flash_ragged_prefill"]

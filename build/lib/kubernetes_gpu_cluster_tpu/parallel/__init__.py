@@ -1,0 +1,34 @@
+"""Device-mesh parallelism: TP/EP/PP/DP over ICI and DCN.
+
+This package is the TPU-native replacement for the reference's entire
+distributed stack — NCCL allreduce inside vLLM images (reference
+``values-01-minimal-example8.yaml:32,53-59``: ``--disable-custom-all-reduce``
+plus 10Gi ``/dev/shm``), and Ray/KubeRay for cross-node pipeline parallelism
+(reference ``values-01-minimal-example4.yaml:18,42-46``, ``old_README.md:1570-1625``).
+
+Design (SURVEY §2 "Parallelism strategies" obligations):
+
+- **mesh.py** — one `jax.sharding.Mesh` with axes
+  ``("dp", "pp", "ep", "sp", "tp")``; TP innermost so it rides ICI, sp next
+  so ring hops stay on-slice, DP/PP outermost so they may cross hosts over
+  DCN. Multi-host bootstrap via `jax.distributed` with stable-DNS coordinator
+  discovery (the JobSet pattern replacing `kubeadm token` ssh plumbing).
+- **sharding.py** — GSPMD sharding-by-annotation for TP and EP: params and the
+  paged KV pool carry `NamedSharding`s, XLA inserts the all-gathers/psums.
+  No hand-written collectives in the hot path.
+- **pp.py** — pipeline parallelism as a `shard_map` circular pipeline:
+  stacked layer weights sharded over ``pp`` on the layer axis, microbatched
+  hidden states rotating stage-to-stage via `lax.ppermute`.
+- **ep.py** — expert parallelism helpers for the mixtral-class MoE block.
+- **sp.py** — sequence/context parallelism: ring attention over the ``sp``
+  axis for long-context prefill (capability the reference lacked entirely —
+  it capped context instead, SURVEY §5 "Long-context").
+"""
+
+from .mesh import make_mesh, initialize_distributed, mesh_from_config
+from .sharding import param_shardings, kv_cache_sharding, data_shardings
+
+__all__ = [
+    "make_mesh", "initialize_distributed", "mesh_from_config",
+    "param_shardings", "kv_cache_sharding", "data_shardings",
+]

@@ -1,0 +1,50 @@
+"""Expert parallelism (EP) for the mixtral-class MoE block.
+
+The reference had no MoE at all (SURVEY §2 "EP: absent — must build"); the
+north-star configs require Mixtral-8x7B expert-parallel across a slice
+(BASELINE.json config 4). Two composable mechanisms provide it:
+
+1. **GSPMD path** (the engine default): expert weights carry
+   ``P(None, "ep", None, "tp")`` shardings (parallel/sharding.py) and the
+   dense-dispatch combine einsum in models/llama._moe_mlp contracts the expert
+   axis, so the SPMD partitioner turns it into local-expert compute + a psum
+   over ``ep`` riding ICI. No dispatch/combine all-to-alls: with the serving
+   hot loop's small token counts, dense dispatch is MXU-bound and avoids the
+   ragged all-to-all entirely.
+
+2. **Manual path** (inside the PP shard_map): ``_moe_mlp(ep_axis="ep")``
+   slices the combine weights to the local expert shard and psums explicitly
+   (see parallel/pp.py).
+
+This module exposes the manual block standalone — used by tests to pin down
+EP semantics against the single-device oracle, and the building block a future
+ragged all-to-all dispatch (large-prefill optimization) will slot into.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import ModelConfig
+from ..models.llama import _moe_mlp
+
+
+def moe_block_ep(mesh: Mesh, cfg: ModelConfig, layer_params: dict, x: jax.Array):
+    """Run one MoE block with experts sharded over the mesh's ``ep`` axis
+    (and per-expert ffn over ``tp``) via shard_map. ``layer_params`` holds one
+    layer's ``router``/``w_gate``/``w_up``/``w_down`` (no leading L axis).
+    x: [T, d] replicated."""
+    if cfg.num_experts % mesh.shape["ep"] != 0:
+        raise ValueError(f"num_experts={cfg.num_experts} not divisible by "
+                         f"ep={mesh.shape['ep']}")
+    in_specs = ({"router": P(),
+                 "w_gate": P("ep", None, "tp"),
+                 "w_up": P("ep", None, "tp"),
+                 "w_down": P("ep", "tp", None)}, P())
+
+    def local_fn(lp, x):
+        return _moe_mlp(lp, x, cfg, tp_axis="tp", ep_axis="ep")
+
+    return jax.shard_map(local_fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=P(), check_vma=False)(layer_params, x)

@@ -1,0 +1,97 @@
+"""Mesh construction + multi-host bootstrap.
+
+Replaces the reference's Ray head/worker process model (reference
+``old_README.md:1615-1625``) with `jax.distributed` SPMD processes, and its
+NCCL fabric with XLA collectives over ICI (intra-slice) / DCN (cross-slice).
+
+Axis order is ``("dp", "pp", "ep", "sp", "tp")`` — innermost (fastest-varying
+over the device list) last, so TP ranks land on ICI-adjacent chips within a
+slice, sp ring neighbors sit one hop apart, while DP/PP cross slice (DCN)
+boundaries. This is the standard TPU layout: bandwidth-hungry tensor-parallel
+collectives stay on ICI, latency-tolerant pipeline hops ride DCN.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..config.engine_config import ParallelConfig
+from ..utils import get_logger
+
+logger = get_logger("parallel.mesh")
+
+MESH_AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+def make_mesh(
+    tp: int = 1,
+    pp: int = 1,
+    dp: int = 1,
+    ep: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> jax.sharding.Mesh:
+    """Build the serving mesh. ``devices`` defaults to all visible devices;
+    world size must equal dp*pp*ep*sp*tp. ``sp`` is the sequence/context-
+    parallel axis (ring attention, parallel/sp.py) — adjacent to tp so ring
+    hops ride ICI neighbors."""
+    if devices is None:
+        devices = jax.devices()
+    world = dp * pp * ep * sp * tp
+    if len(devices) < world:
+        raise ValueError(
+            f"need {world} devices for dp={dp} pp={pp} ep={ep} sp={sp} "
+            f"tp={tp}, have {len(devices)}")
+    devs = np.asarray(devices[:world]).reshape(dp, pp, ep, sp, tp)
+    return jax.sharding.Mesh(devs, MESH_AXES)
+
+
+def mesh_from_config(cfg: ParallelConfig,
+                     devices: Optional[Sequence[jax.Device]] = None,
+                     ) -> Optional[jax.sharding.Mesh]:
+    """Mesh for an EngineConfig.parallel; None when single-device (the engine
+    then skips all sharding annotations)."""
+    if cfg.world_size == 1:
+        return None
+    return make_mesh(tp=cfg.tp, pp=cfg.pp, dp=cfg.dp, ep=cfg.ep, devices=devices)
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bootstrap: `jax.distributed.initialize` with K8s-native
+    discovery.
+
+    The reference bootstrapped its multi-node layer by hand —
+    ``kubeadm token create`` piped over ssh (reference ``README.md:62``) and a
+    Ray head node address in Helm values (``values-01-minimal-example4.yaml:42-46``).
+    Here worker pods discover the coordinator through a stable headless-Service
+    DNS name injected as env (the JobSet pattern, SURVEY §5 "Distributed
+    communication backend"):
+
+    - ``KGCT_COORDINATOR`` — ``<pod-0-dns>:<port>`` of process 0
+    - ``KGCT_NUM_PROCESSES`` — world size in processes (hosts)
+    - ``KGCT_PROCESS_ID`` — this pod's rank (from the StatefulSet/JobSet index)
+
+    On a single host (or when already initialized) this is a no-op.
+    """
+    coordinator_address = coordinator_address or os.environ.get("KGCT_COORDINATOR")
+    if coordinator_address is None:
+        logger.info("no coordinator configured; single-process run")
+        return
+    num_processes = num_processes or int(os.environ.get("KGCT_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("KGCT_PROCESS_ID", "0"))
+    logger.info("jax.distributed.initialize(%s, num=%d, id=%d)",
+                coordinator_address, num_processes, process_id)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,126 @@
+"""GSPMD sharding rules for TP (+EP) serving.
+
+The reference's TP was NCCL tensor-parallelism inside vLLM CUDA workers,
+configured but not owned (reference ``values-01-minimal-example8.yaml:35-38``).
+Here TP is sharding-by-annotation: params and the paged KV pool carry
+`NamedSharding`s over the mesh's ``tp``/``ep`` axes and XLA's SPMD partitioner
+inserts the collectives (all-gather on the attention output projection, psum
+on the MLP down-projection and MoE combine) — all riding ICI. There is no
+hand-scheduled collective anywhere in the hot path, and nothing like the
+reference's ``/dev/shm`` sizing or ``--disable-custom-all-reduce`` escape
+hatches is needed.
+
+Megatron-style layout over the stacked ``[L, ...]`` params of models/llama.py:
+
+- attention: q/k/v projections column-sharded (heads split over ``tp``),
+  output projection row-sharded -> one psum per attention block;
+- MLP: gate/up column-sharded, down row-sharded -> one psum per MLP;
+- MoE: expert axis over ``ep``, per-expert ffn over ``tp``; the dense-dispatch
+  combine einsum contracts the expert axis -> psum over ``ep``;
+- embedding vocab-sharded (lookup becomes local-gather + psum), lm_head
+  vocab-sharded (logits all-gather before sampling, B<=max_num_seqs rows);
+- KV pool sharded over kv heads when divisible, else replicated (GQA models
+  with few kv heads at high TP keep full KV per device, matching the
+  replicate-kv-heads practice).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import ModelConfig
+from ..utils import get_logger
+
+logger = get_logger("parallel.sharding")
+
+
+def _ns(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def _axis(mesh: Mesh, name: str) -> int:
+    return mesh.shape.get(name, 1)
+
+
+def param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict[str, Any]:
+    """NamedSharding pytree matching models.llama.init_params structure."""
+    tp = _axis(mesh, "tp")
+    ep = _axis(mesh, "ep")
+    if cfg.num_heads % tp != 0:
+        raise ValueError(f"num_heads={cfg.num_heads} not divisible by tp={tp}")
+    # kv heads: shard when divisible, otherwise replicate (GQA practice).
+    kv_tp = "tp" if cfg.num_kv_heads % tp == 0 else None
+    if kv_tp is None and tp > 1:
+        logger.info("kv heads (%d) replicated across tp=%d", cfg.num_kv_heads, tp)
+    if cfg.is_moe and cfg.num_experts % ep != 0:
+        raise ValueError(f"num_experts={cfg.num_experts} not divisible by ep={ep}")
+
+    layers: dict[str, Any] = {
+        "input_norm": _ns(mesh),
+        "post_attn_norm": _ns(mesh),
+        "wq": _ns(mesh, None, None, "tp"),
+        "wk": _ns(mesh, None, None, kv_tp),
+        "wv": _ns(mesh, None, None, kv_tp),
+        "wo": _ns(mesh, None, "tp", None),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = _ns(mesh, None, "tp")
+        layers["bk"] = _ns(mesh, None, kv_tp)
+        layers["bv"] = _ns(mesh, None, kv_tp)
+    if cfg.qk_norm:
+        layers["q_norm"] = _ns(mesh)
+        layers["k_norm"] = _ns(mesh)
+    if cfg.is_moe:
+        layers["router"] = _ns(mesh)
+        layers["w_gate"] = _ns(mesh, None, "ep", None, "tp")
+        layers["w_up"] = _ns(mesh, None, "ep", None, "tp")
+        layers["w_down"] = _ns(mesh, None, "ep", "tp", None)
+    else:
+        layers["w_gate"] = _ns(mesh, None, None, "tp")
+        layers["w_up"] = _ns(mesh, None, None, "tp")
+        layers["w_down"] = _ns(mesh, None, "tp", None)
+
+    shardings: dict[str, Any] = {
+        "embed": _ns(mesh, "tp", None),     # vocab-sharded
+        "final_norm": _ns(mesh),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        shardings["lm_head"] = _ns(mesh, None, "tp")
+    if cfg.quantization:
+        # Per-output-channel scales shard exactly like their weight's OUT
+        # axis (ops/quant.py): column-sharded weights carry sharded scales,
+        # row-sharded weights have unsharded outputs -> replicated scales.
+        layers["wq_scale"] = _ns(mesh, None, "tp")
+        layers["wk_scale"] = _ns(mesh, None, kv_tp)
+        layers["wv_scale"] = _ns(mesh, None, kv_tp)
+        layers["wo_scale"] = _ns(mesh)
+        if cfg.is_moe:
+            layers["w_gate_scale"] = _ns(mesh, None, "ep", "tp")
+            layers["w_up_scale"] = _ns(mesh, None, "ep", "tp")
+            layers["w_down_scale"] = _ns(mesh, None, "ep", None)
+        else:
+            layers["w_gate_scale"] = _ns(mesh, None, "tp")
+            layers["w_up_scale"] = _ns(mesh, None, "tp")
+            layers["w_down_scale"] = _ns(mesh)
+        if not cfg.tie_word_embeddings:
+            shardings["lm_head_scale"] = _ns(mesh, "tp")
+    return shardings
+
+
+def kv_cache_sharding(mesh: Mesh, cfg: ModelConfig) -> NamedSharding:
+    """Paged pool [L, P, page_size, n_kv*head_dim]: shard the flattened head
+    dim over tp when kv heads divide it (the contiguous chunks then coincide
+    with kv-head groups, so each device streams only its heads' pages)."""
+    tp = _axis(mesh, "tp")
+    kv_tp = "tp" if cfg.num_kv_heads % tp == 0 else None
+    return _ns(mesh, None, None, None, kv_tp)
+
+
+def data_shardings(mesh: Mesh) -> NamedSharding:
+    """Step inputs (tokens/meta arrays) are small host-produced int arrays;
+    replicate them — GSPMD then partitions activations from the params."""
+    return _ns(mesh)

@@ -1,0 +1,14 @@
+"""Serving layer: tokenizer, async engine, OpenAI-compatible API, router.
+
+The user-facing surface the reference delivered via the vLLM Helm chart's
+router + engine pods (reference ``old_README.md:1472-1476``), native here.
+"""
+
+from .async_engine import AsyncLLMEngine, StreamChunk
+from .tokenizer import (ByteTokenizer, HFTokenizer, IncrementalDetokenizer,
+                        apply_chat_template, load_tokenizer)
+
+__all__ = [
+    "AsyncLLMEngine", "StreamChunk", "ByteTokenizer", "HFTokenizer",
+    "IncrementalDetokenizer", "apply_chat_template", "load_tokenizer",
+]

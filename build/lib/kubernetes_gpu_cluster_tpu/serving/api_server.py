@@ -1,0 +1,397 @@
+"""OpenAI-compatible HTTP server over AsyncLLMEngine (aiohttp).
+
+The reference's user-facing contract: an OpenAI API served behind
+``vllm-router-service`` and reached via port-forward
+(``old_README.md:1174-1176, 1472-1476``). Endpoints:
+
+- ``POST /v1/completions``        text in -> text out, optional SSE streaming
+- ``POST /v1/chat/completions``   chat messages via the model's chat template
+- ``GET  /v1/models``             the model card the router aggregates
+- ``GET  /health``                liveness + engine queue depth
+- ``GET  /metrics``               Prometheus text format (serving.metrics)
+
+Stop semantics: stop TOKEN ids fire inside the engine; stop STRINGS are
+evaluated here on incrementally detokenized text (IncrementalDetokenizer
+holds back a potential partial match, then the request is aborted
+engine-side so no further device work is spent on it).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Optional
+
+from aiohttp import web
+
+from ..config import EngineConfig
+from ..engine import SamplingParams
+from ..utils import get_logger
+from .async_engine import AsyncLLMEngine
+from .metrics import Metrics
+from .tokenizer import (IncrementalDetokenizer, Tokenizer,
+                        apply_chat_template, load_tokenizer)
+
+logger = get_logger("serving.api")
+
+
+def _sampling_params(body: dict, eos_token_id: Optional[int]) -> SamplingParams:
+    return SamplingParams(
+        max_tokens=int(body.get("max_tokens") or 256),
+        temperature=float(body.get("temperature", 1.0)),
+        top_p=float(body.get("top_p", 1.0)),
+        top_k=int(body.get("top_k", 0)),
+        stop_token_ids=tuple([eos_token_id] if eos_token_id is not None else [])
+        + tuple(body.get("stop_token_ids") or ()),
+    )
+
+
+def _stops(body: dict) -> list[str]:
+    stop = body.get("stop")
+    if stop is None:
+        return []
+    return [stop] if isinstance(stop, str) else list(stop)
+
+
+class APIServer:
+    def __init__(self, engine: AsyncLLMEngine, tokenizer: Tokenizer,
+                 model_name: str):
+        import asyncio
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.metrics = Metrics(engine.engine)
+        self._profile_lock = asyncio.Lock()
+
+    # -- app wiring ----------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.prometheus)
+        app.router.add_post("/debug/profile", self.profile)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app: web.Application) -> None:
+        import asyncio
+        self.engine.start(asyncio.get_running_loop())
+
+    async def _on_cleanup(self, app: web.Application) -> None:
+        self.engine.shutdown()
+
+    # -- endpoints -----------------------------------------------------------
+
+    async def health(self, request: web.Request) -> web.Response:
+        sched = self.engine.engine.scheduler
+        return web.json_response({
+            "status": "ok", "model": self.model_name,
+            "waiting": len(sched.waiting), "running": len(sched.running)})
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(),
+                            content_type="text/plain")
+
+    async def profile(self, request: web.Request) -> web.Response:
+        """Capture a jax.profiler trace of live serving traffic.
+
+        ``POST /debug/profile?seconds=3`` blocks for the window and returns
+        the trace directory (under /tmp/kgct-profile; open with
+        xprof/tensorboard). One capture at a time — concurrent requests get
+        409 rather than clobbering the active trace. The observability the
+        reference lacked entirely (SURVEY §5 "Tracing/profiling: none")."""
+        import asyncio
+
+        import jax
+
+        if self._profile_lock.locked():
+            return _error(409, "a profile capture is already running")
+        async with self._profile_lock:
+            seconds = float(request.query.get("seconds", 3))
+            seconds = min(max(seconds, 0.1), 60.0)
+            trace_dir = "/tmp/kgct-profile"
+            try:
+                jax.profiler.start_trace(trace_dir)
+                await asyncio.sleep(seconds)
+            finally:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:
+                    return _error(500, f"profiler stop failed: {e}")
+        return web.json_response({"trace_dir": trace_dir,
+                                  "seconds": seconds})
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": self.model_name, "object": "model",
+                      "owned_by": "kubernetes-gpu-cluster-tpu"}]})
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        prompt = body.get("prompt")
+        if prompt is None:
+            return _error(400, "missing 'prompt'")
+        if isinstance(prompt, list):
+            if prompt and isinstance(prompt[0], int):
+                ids = [int(t) for t in prompt]
+            elif len(prompt) == 1 and isinstance(prompt[0], str):
+                ids = self.tokenizer.encode(prompt[0])
+            else:
+                return _error(400, "batched prompts are not supported; "
+                                   "send one request per prompt")
+        else:
+            ids = self.tokenizer.encode(prompt)
+        return await self._run(request, body, ids, kind="completion")
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        messages = body.get("messages")
+        if not messages:
+            return _error(400, "missing 'messages'")
+        text = apply_chat_template(self.tokenizer, messages)
+        ids = self.tokenizer.encode(text)
+        return await self._run(request, body, ids, kind="chat.completion")
+
+    # -- request execution ---------------------------------------------------
+
+    async def _run(self, request: web.Request, body: dict, ids: list[int],
+                   kind: str) -> web.StreamResponse:
+        params = _sampling_params(body, self.tokenizer.eos_token_id)
+        detok = IncrementalDetokenizer(self.tokenizer, stop=_stops(body))
+        rid = self.engine.next_request_id(
+            "cmpl" if kind == "completion" else "chatcmpl")
+        created = int(time.time())
+        stream = bool(body.get("stream"))
+        self.metrics.on_request()
+
+        # ``complete`` guards the engine-side abort: any early handler exit —
+        # asyncio.CancelledError when aiohttp cancels the task on client
+        # disconnect, ConnectionResetError mid-SSE-write, any bug — must stop
+        # the request on-device, or an abandoned request keeps generating
+        # until max_tokens (a device-time leak under client churn).
+        gen = self.engine.generate(rid, ids, params)
+        complete = False
+        if not stream:
+            try:
+                text, finish_reason, n_out = await self._collect(gen, detok, rid)
+                complete = True
+            except ValueError as e:
+                complete = True      # engine already rejected/finished it
+                self.metrics.on_finish(0)  # a 400 is still a delivered response
+                return _error(400, str(e))
+            finally:
+                if not complete:
+                    self.engine.abort(rid)
+            self.metrics.on_finish(n_out)
+            return web.json_response(_response_body(
+                kind, rid, created, self.model_name, text, finish_reason,
+                prompt_tokens=len(ids), completion_tokens=n_out))
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache"})
+        await resp.prepare(request)
+        n_out = 0
+        try:
+            async for chunk in gen:
+                n_out = len(chunk.output_token_ids)
+                delta = detok.push(chunk.new_token_ids, final=chunk.finished)
+                finished = chunk.finished or detok.stopped
+                if detok.stopped and not chunk.finished:
+                    self.engine.abort(rid)
+                if delta or finished:
+                    reason = ("stop" if detok.stopped
+                              else _map_reason(chunk.finish_reason))
+                    await resp.write(_sse(_stream_body(
+                        kind, rid, created, self.model_name, delta,
+                        reason if finished else None)))
+                if finished:
+                    complete = True
+                    break
+        except ValueError as e:
+            complete = True
+            await resp.write(_sse({"error": {"message": str(e), "code": 400}}))
+        finally:
+            if not complete:
+                self.engine.abort(rid)
+        self.metrics.on_finish(n_out)
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    async def _collect(self, gen, detok: IncrementalDetokenizer, rid: str):
+        text = []
+        finish_reason = None
+        n_out = 0
+        async for chunk in gen:
+            n_out = len(chunk.output_token_ids)
+            text.append(detok.push(chunk.new_token_ids, final=chunk.finished))
+            if detok.stopped:
+                if not chunk.finished:
+                    self.engine.abort(rid)
+                finish_reason = "stop"
+                break
+            if chunk.finished:
+                finish_reason = _map_reason(chunk.finish_reason)
+        return "".join(text), finish_reason, n_out
+
+
+# -- OpenAI wire formats ----------------------------------------------------
+
+def _map_reason(reason: Optional[str]) -> Optional[str]:
+    return {"eos": "stop", "stop_token": "stop", "length": "length",
+            "abort": "abort"}.get(reason or "", reason)
+
+
+def _response_body(kind, rid, created, model, text, finish_reason, *,
+                   prompt_tokens, completion_tokens) -> dict:
+    choice: dict[str, Any] = {"index": 0, "finish_reason": finish_reason}
+    if kind == "completion":
+        choice["text"] = text
+    else:
+        choice["message"] = {"role": "assistant", "content": text}
+    return {
+        "id": rid, "object": kind, "created": created, "model": model,
+        "choices": [choice],
+        "usage": {"prompt_tokens": prompt_tokens,
+                  "completion_tokens": completion_tokens,
+                  "total_tokens": prompt_tokens + completion_tokens}}
+
+
+def _stream_body(kind, rid, created, model, delta, finish_reason) -> dict:
+    choice: dict[str, Any] = {"index": 0, "finish_reason": finish_reason}
+    if kind == "completion":
+        choice["text"] = delta
+        obj = "text_completion"
+    else:
+        choice["delta"] = {"content": delta} if delta else {}
+        obj = "chat.completion.chunk"
+    return {"id": rid, "object": obj, "created": created, "model": model,
+            "choices": [choice]}
+
+
+def _sse(obj: dict) -> bytes:
+    return f"data: {json.dumps(obj)}\n\n".encode()
+
+
+def _error(status: int, message: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": "invalid_request_error",
+                   "code": status}},
+        status=status)
+
+
+# -- entry point -------------------------------------------------------------
+
+def build_server(config: EngineConfig, tokenizer_path: Optional[str] = None,
+                 model_name: Optional[str] = None, params=None,
+                 mesh=None) -> APIServer:
+    tokenizer = load_tokenizer(tokenizer_path)
+    engine = AsyncLLMEngine(config, params=params,
+                            eos_token_id=tokenizer.eos_token_id, mesh=mesh)
+    return APIServer(engine, tokenizer, model_name or config.model.name)
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """CLI: python -m kubernetes_gpu_cluster_tpu.serving.api_server
+    --model tinyllama-1.1b --port 8000 [--tokenizer /models/TinyLlama]
+
+    Flag names mirror the reference's vllmConfig/extraArgs surface
+    (values-01-minimal-example8.yaml:24-38) so cluster/deploy-rendered
+    manifests — and operators' muscle memory — carry over: --tensor-parallel-
+    size, --pipeline-parallel-size, --gpu-memory-utilization (alias of
+    --hbm-utilization), --max-model-len, --dtype, --enforce-eager. GPU-only
+    knobs the reference files carry (--disable-custom-all-reduce,
+    --trust-remote-code) are accepted and ignored with a notice: ICI
+    collectives have no custom-allreduce path and checkpoints are local."""
+    import argparse
+
+    from ..config import CacheConfig, ParallelConfig, get_model_config
+    from ..parallel import initialize_distributed, make_mesh
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", required=True)
+    p.add_argument("--tokenizer", default=None,
+                   help="local HF tokenizer dir; default: byte tokenizer")
+    p.add_argument("--weights", default=None,
+                   help="local safetensors dir; default: random init")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--max-model-len", type=int, default=None)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--pipeline-parallel-size", type=int, default=1)
+    p.add_argument("--hbm-utilization", "--gpu-memory-utilization",
+                   dest="hbm_utilization", type=float, default=0.90,
+                   help="fraction of free HBM given to the KV page pool")
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--dtype", default=None,
+                   help="serving dtype override (bfloat16/float32; float16 "
+                   "maps to bfloat16 on TPU)")
+    p.add_argument("--quantization", default=None, choices=["int8"],
+                   help="weight-only int8 (W8A16): halves HBM weight "
+                   "streaming; applied to any checkpoint at load")
+    p.add_argument("--enable-prefix-caching", action="store_true",
+                   help="reuse KV pages across requests sharing a "
+                   "page-aligned prompt prefix (vLLM parity)")
+    p.add_argument("--enforce-eager", action="store_true",
+                   help="disable jit compile caching (debug; always slower)")
+    p.add_argument("--trust-remote-code", action="store_true",
+                   help="accepted for reference-values parity; local "
+                   "checkpoints never execute remote code here")
+    p.add_argument("--disable-custom-all-reduce", action="store_true",
+                   help="accepted for reference-values parity; XLA ICI "
+                   "collectives have no custom-allreduce path to disable")
+    p.add_argument("--distributed", action="store_true",
+                   help="call jax.distributed initialize (multi-host pods; "
+                   "coordinator from KGCT_COORDINATOR, see parallel/mesh.py)")
+    args = p.parse_args(argv)
+
+    if args.distributed:
+        initialize_distributed()
+    model_cfg = get_model_config(args.model)
+    if args.dtype:
+        dtype = {"float16": "bfloat16", "half": "bfloat16",
+                 "bf16": "bfloat16"}.get(args.dtype, args.dtype)
+        model_cfg = model_cfg.replace(dtype=dtype)
+    if args.quantization:
+        model_cfg = model_cfg.replace(quantization=args.quantization)
+    if args.trust_remote_code or args.disable_custom_all_reduce:
+        logger.info("GPU-parity flags accepted and ignored "
+                    "(--trust-remote-code / --disable-custom-all-reduce)")
+    from ..config import SchedulerConfig
+    config = EngineConfig(
+        model=model_cfg,
+        cache=CacheConfig(hbm_utilization=args.hbm_utilization),
+        scheduler=SchedulerConfig(
+            max_num_seqs=args.max_num_seqs,
+            enable_prefix_caching=args.enable_prefix_caching),
+        parallel=ParallelConfig(tp=args.tensor_parallel_size,
+                                pp=args.pipeline_parallel_size),
+        max_model_len=args.max_model_len,
+        enforce_eager=args.enforce_eager)
+    mesh = None
+    if config.parallel.world_size > 1:
+        mesh = make_mesh(tp=config.parallel.tp, pp=config.parallel.pp)
+    params = None
+    if args.weights:
+        from ..engine.weights import load_weights
+        params = load_weights(args.weights, config.model)
+    server = build_server(config, args.tokenizer, args.model, params=params,
+                          mesh=mesh)
+    web.run_app(server.build_app(), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

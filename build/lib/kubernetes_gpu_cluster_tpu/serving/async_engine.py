@@ -1,0 +1,148 @@
+"""AsyncLLMEngine: asyncio front door over the blocking LLMEngine.
+
+The engine's step() blocks on device sync, so it runs on a dedicated worker
+thread; request submission and output streaming cross the thread boundary
+through a thread-safe inbox and ``loop.call_soon_threadsafe`` fan-out into
+per-request asyncio queues. This is the piece that turns the batch engine
+into the always-on serving process behind the OpenAI API (the role vLLM's
+AsyncLLMEngine played inside the images the reference deployed,
+``old_README.md:1078-1176``).
+
+The worker thread idles on a condition variable when there is no work — an
+idle replica burns no CPU and wakes in O(µs) on the first request.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import itertools
+import threading
+from typing import AsyncIterator, Optional
+
+from ..config import EngineConfig
+from ..engine import LLMEngine, RequestOutput, SamplingParams
+from ..utils import get_logger
+
+logger = get_logger("serving.async_engine")
+
+
+@dataclasses.dataclass
+class StreamChunk:
+    """One step's worth of progress for a request."""
+    request_id: str
+    new_token_ids: list[int]
+    output_token_ids: list[int]
+    finished: bool
+    finish_reason: Optional[str]
+
+
+class AsyncLLMEngine:
+    def __init__(self, config: EngineConfig, params=None,
+                 eos_token_id: Optional[int] = None, mesh=None):
+        self.engine = LLMEngine(config, params=params,
+                                eos_token_id=eos_token_id, mesh=mesh)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._queues: dict[str, asyncio.Queue] = {}
+        self._inbox: list = []            # (request_id, token_ids, params)
+        self._aborts: list[str] = []
+        self._cv = threading.Condition()
+        self._shutdown = False
+        self._counter = itertools.count()
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="kgct-engine-step-loop")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, loop: Optional[asyncio.AbstractEventLoop] = None) -> None:
+        self._loop = loop or asyncio.get_event_loop()
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify()
+        self._thread.join(timeout=30)
+
+    # -- request API ---------------------------------------------------------
+
+    def next_request_id(self, prefix: str = "cmpl") -> str:
+        return f"{prefix}-{next(self._counter)}"
+
+    async def generate(self, request_id: str, prompt_token_ids: list[int],
+                       params: SamplingParams) -> AsyncIterator[StreamChunk]:
+        """Submit a request and yield StreamChunks until finished."""
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[request_id] = queue
+        with self._cv:
+            self._inbox.append((request_id, prompt_token_ids, params))
+            self._cv.notify()
+        try:
+            while True:
+                chunk = await queue.get()
+                if isinstance(chunk, Exception):
+                    raise chunk
+                yield chunk
+                if chunk.finished:
+                    return
+        finally:
+            self._queues.pop(request_id, None)
+
+    def abort(self, request_id: str) -> None:
+        with self._cv:
+            self._aborts.append(request_id)
+            self._cv.notify()
+
+    # -- worker thread -------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not (self._shutdown or self._inbox or self._aborts
+                           or self.engine.has_unfinished_requests()):
+                    self._cv.wait()
+                if self._shutdown:
+                    return
+                inbox, self._inbox = self._inbox, []
+                aborts, self._aborts = self._aborts, []
+            # A request whose add and abort arrived in the same wakeup must
+            # not be admitted: the abort would no-op (nothing to abort yet)
+            # and the request would then run orphaned to completion.
+            aborted = set(aborts)
+            inbox = [item for item in inbox if item[0] not in aborted]
+            for rid in aborts:
+                self.engine.abort_request(rid)
+                self._post(StreamChunk(rid, [], [], True, "abort"))
+            for rid, ids, params in inbox:
+                try:
+                    self.engine.add_request(rid, ids, params)
+                except ValueError as e:   # oversized prompt etc.
+                    self._post_exc(rid, e)
+            if self.engine.has_unfinished_requests():
+                try:
+                    for out in self.engine.step():
+                        self._post(_chunk_of(out))
+                except Exception as e:  # engine wedged: fail all waiters
+                    logger.exception("engine step failed")
+                    for rid in list(self._queues):
+                        self._post_exc(rid, e)
+                    return
+
+    def _post(self, chunk: StreamChunk) -> None:
+        queue = self._queues.get(chunk.request_id)
+        if queue is not None and self._loop is not None:
+            self._loop.call_soon_threadsafe(queue.put_nowait, chunk)
+
+    def _post_exc(self, request_id: str, exc: Exception) -> None:
+        queue = self._queues.get(request_id)
+        if queue is not None and self._loop is not None:
+            self._loop.call_soon_threadsafe(queue.put_nowait, exc)
+
+
+def _chunk_of(out: RequestOutput) -> StreamChunk:
+    return StreamChunk(
+        request_id=out.request_id,
+        new_token_ids=list(out.new_token_ids or []),
+        output_token_ids=list(out.output_token_ids),
+        finished=out.finished,
+        finish_reason=out.finish_reason)

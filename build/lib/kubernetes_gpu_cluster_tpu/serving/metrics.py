@@ -1,0 +1,81 @@
+"""Prometheus-format serving metrics (/metrics endpoint).
+
+The reference had NO metrics surface at all — observability was kubectl
+transcripts (SURVEY §5 "Metrics/logging/observability: no Prometheus/
+Grafana") — so this is framework-over-reference functionality the north star
+asks for: tok/s, TTFT p50/p95 under continuous batching, preemptions, KV page
+occupancy.
+
+Counters come from engine.EngineStats (filled inside the step loop) and
+scheduler/allocator state; this module only formats. Text format per the
+Prometheus exposition spec — scrapeable without any client library.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Metrics:
+    def __init__(self, engine):
+        self.engine = engine               # LLMEngine
+        self.requests_total = 0
+        self.responses_total = 0
+        self.response_tokens_total = 0
+        self._started = time.monotonic()
+
+    # -- hooks called by the API layer --------------------------------------
+
+    def on_request(self) -> None:
+        self.requests_total += 1
+
+    def on_finish(self, n_tokens: int) -> None:
+        """HTTP-layer completion: counts responses actually delivered to
+        clients (engine-side requests_finished also covers aborts/terminated
+        sequences, so the two legitimately differ under churn)."""
+        self.responses_total += 1
+        self.response_tokens_total += n_tokens
+
+    # -- rendering ----------------------------------------------------------
+
+    def render(self) -> str:
+        eng = self.engine
+        stats = eng.stats
+        sched = eng.scheduler
+        alloc = sched.allocator
+        q = stats.quantile
+        lines = [
+            "# TYPE kgct_requests_total counter",
+            f"kgct_requests_total {self.requests_total}",
+            "# TYPE kgct_responses_total counter",
+            f"kgct_responses_total {self.responses_total}",
+            "# TYPE kgct_response_tokens_total counter",
+            f"kgct_response_tokens_total {self.response_tokens_total}",
+            "# TYPE kgct_requests_finished_total counter",
+            f"kgct_requests_finished_total {stats.requests_finished}",
+            "# TYPE kgct_tokens_generated_total counter",
+            f"kgct_tokens_generated_total {stats.tokens_generated}",
+            "# TYPE kgct_prefill_tokens_total counter",
+            f"kgct_prefill_tokens_total {stats.prefill_tokens}",
+            "# TYPE kgct_engine_steps_total counter",
+            f"kgct_engine_steps_total {stats.steps}",
+            "# TYPE kgct_preemptions_total counter",
+            f"kgct_preemptions_total {sched.num_preemptions}",
+            "# TYPE kgct_num_waiting gauge",
+            f"kgct_num_waiting {len(sched.waiting)}",
+            "# TYPE kgct_num_running gauge",
+            f"kgct_num_running {len(sched.running)}",
+            "# TYPE kgct_kv_pages_total gauge",
+            f"kgct_kv_pages_total {alloc.num_pages}",
+            "# TYPE kgct_kv_pages_free gauge",
+            f"kgct_kv_pages_free {alloc.num_free}",
+            "# TYPE kgct_ttft_seconds summary",
+            f'kgct_ttft_seconds{{quantile="0.5"}} {q(stats.ttft_s, 0.5)}',
+            f'kgct_ttft_seconds{{quantile="0.95"}} {q(stats.ttft_s, 0.95)}',
+            "# TYPE kgct_step_seconds summary",
+            f'kgct_step_seconds{{quantile="0.5"}} {q(stats.step_s, 0.5)}',
+            f'kgct_step_seconds{{quantile="0.95"}} {q(stats.step_s, 0.95)}',
+            "# TYPE kgct_uptime_seconds gauge",
+            f"kgct_uptime_seconds {time.monotonic() - self._started:.1f}",
+        ]
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,285 @@
+"""Request router: one front door over N data-parallel engine replicas.
+
+The reference exposed its replicas behind ``vllm-router-service`` and
+operators port-forwarded to it (``old_README.md:1174-1176, 1472-1476``);
+replicas were plain Deployment pods spread by anti-affinity
+(``values-01-minimal-example2.yaml:10, 23-49``). This router is the native
+equivalent: an aiohttp reverse proxy that
+
+- tracks replica health (periodic GET /health; unhealthy replicas leave the
+  rotation and return on recovery — the k8s-native restart/rollout story of
+  SURVEY §5.3 at the traffic layer),
+- balances by least-outstanding-requests (better than round-robin under
+  continuous batching: a replica stuck on long generations accumulates
+  in-flight count and sheds new work),
+- streams responses through unbuffered (SSE passthrough).
+
+In-cluster, replica discovery is the headless-Service DNS name; static URLs
+work for local/dev. Deployment manifests are rendered by
+kubernetes_gpu_cluster_tpu.deploy (router Deployment + kgct-router-service).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import itertools
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from ..utils import get_logger
+
+logger = get_logger("serving.router")
+
+HOP_HEADERS = {"transfer-encoding", "content-length", "connection",
+               "keep-alive", "host"}
+
+
+class Replica:
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.healthy = True
+        self.inflight = 0
+        self.consecutive_failures = 0
+
+
+class Router:
+    def __init__(self, replica_urls: list[str],
+                 health_interval_s: float = 5.0,
+                 fail_threshold: int = 2):
+        self.replicas = [Replica(u) for u in replica_urls]
+        self.health_interval_s = health_interval_s
+        self.fail_threshold = fail_threshold
+        self._rr = itertools.count()
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._health_task: Optional[asyncio.Task] = None
+
+    # -- app wiring ----------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/v1/models", self.proxy)
+        app.router.add_post("/v1/completions", self.proxy)
+        app.router.add_post("/v1/chat/completions", self.proxy)
+        app.router.add_get("/metrics", self.metrics)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app: web.Application) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10))
+        self._health_task = asyncio.create_task(self._health_loop())
+
+    async def _on_cleanup(self, app: web.Application) -> None:
+        if self._health_task:
+            self._health_task.cancel()
+        if self._session:
+            await self._session.close()
+
+    # -- health --------------------------------------------------------------
+
+    async def _health_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.health_interval_s)
+            await asyncio.gather(*(self._check(r) for r in self.replicas),
+                                 return_exceptions=True)
+
+    async def _check(self, replica: Replica) -> None:
+        try:
+            async with self._session.get(f"{replica.url}/health") as resp:
+                ok = resp.status == 200
+        except Exception:
+            ok = False
+        if ok:
+            replica.consecutive_failures = 0
+            if not replica.healthy:
+                logger.info("replica %s back in rotation", replica.url)
+            replica.healthy = True
+        else:
+            replica.consecutive_failures += 1
+            if (replica.healthy
+                    and replica.consecutive_failures >= self.fail_threshold):
+                logger.warning("replica %s marked unhealthy", replica.url)
+                replica.healthy = False
+
+    async def health(self, request: web.Request) -> web.Response:
+        healthy = [r.url for r in self.replicas if r.healthy]
+        status = 200 if healthy else 503
+        return web.json_response(
+            {"status": "ok" if healthy else "no healthy replicas",
+             "replicas": {r.url: {"healthy": r.healthy,
+                                  "inflight": r.inflight}
+                          for r in self.replicas}},
+            status=status)
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        lines = ["# TYPE kgct_router_replica_healthy gauge",
+                 "# TYPE kgct_router_replica_inflight gauge"]
+        for r in self.replicas:
+            lines.append(f'kgct_router_replica_healthy{{replica="{r.url}"}} '
+                         f"{int(r.healthy)}")
+            lines.append(f'kgct_router_replica_inflight{{replica="{r.url}"}} '
+                         f"{r.inflight}")
+        # Aggregate each healthy replica's engine metrics behind the single
+        # front door (one scrape target for the whole DP group), labelled by
+        # replica so series do not collide.
+        fetched = await asyncio.gather(
+            *(self._fetch_metrics(r) for r in self.replicas if r.healthy),
+            return_exceptions=True)
+        # One TYPE line per metric name across ALL replicas — duplicates make
+        # the whole exposition invalid to Prometheus parsers.
+        seen_types: set[str] = set()
+        for res in fetched:
+            if isinstance(res, BaseException):
+                continue
+            for kind, line in res:
+                if kind is None:
+                    lines.append(line)
+                elif kind not in seen_types:
+                    seen_types.add(kind)
+                    lines.append(line)
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+    async def _fetch_metrics(self, replica: Replica):
+        """Returns (metric_name_or_None, line) pairs: name set for TYPE lines
+        (deduped by the caller), None for relabelled samples."""
+        async with self._session.get(f"{replica.url}/metrics",
+                                     timeout=aiohttp.ClientTimeout(total=5)
+                                     ) as resp:
+            text = await resp.text()
+        label = f'replica="{replica.url}"'
+        out = []
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                if line.startswith("# TYPE"):
+                    parts = line.split()
+                    out.append((parts[2] if len(parts) > 2 else line, line))
+                continue
+            name, _, rest = line.partition(" ")
+            if "{" in name:
+                base, _, labels = name.partition("{")
+                out.append((None, f"{base}{{{label},{labels} {rest}"))
+            else:
+                out.append((None, f"{name}{{{label}}} {rest}"))
+        return out
+
+    # -- proxying ------------------------------------------------------------
+
+    def _pick(self, exclude: Optional[set] = None) -> Optional[Replica]:
+        healthy = [r for r in self.replicas
+                   if r.healthy and (not exclude or r.url not in exclude)]
+        if not healthy:
+            return None
+        least = min(r.inflight for r in healthy)
+        tied = [r for r in healthy if r.inflight == least]
+        return tied[next(self._rr) % len(tied)]
+
+    async def proxy(self, request: web.Request) -> web.StreamResponse:
+        """Reverse-proxy with failover.
+
+        Only CONNECT-phase failures (replica down/unreachable) fail over to
+        the next healthy replica — a request the upstream already received
+        may be mid-generation there, and re-sending it would silently double
+        device work under exactly the overload that causes resets. Upstream
+        errors after the body was delivered return 502; after streaming to
+        the client started, the stream is terminated (truncation is the
+        signal). Client-side disconnects never count against the replica."""
+        body = await request.read()
+        tried: set[str] = set()
+        last_err: Optional[Exception] = None
+        while True:
+            replica = self._pick(exclude=tried)
+            if replica is None:
+                break
+            tried.add(replica.url)
+            replica.inflight += 1
+            try:
+                try:
+                    upstream_cm = self._session.request(
+                        request.method, f"{replica.url}{request.path_qs}",
+                        data=body if body else None,
+                        headers={k: v for k, v in request.headers.items()
+                                 if k.lower() not in HOP_HEADERS})
+                    upstream = await upstream_cm.__aenter__()
+                except aiohttp.ClientConnectorError as e:
+                    # TCP connect failed: nothing reached the upstream —
+                    # safe to fail over.
+                    last_err = e
+                    self._count_failure(replica, e)
+                    continue
+                except aiohttp.ClientError as e:
+                    # Request sent (at least partially) but no response: the
+                    # upstream may already be processing it — do NOT re-send.
+                    last_err = e
+                    self._count_failure(replica, e)
+                    break
+                try:
+                    resp = web.StreamResponse(status=upstream.status)
+                    for k, v in upstream.headers.items():
+                        if k.lower() not in HOP_HEADERS:
+                            resp.headers[k] = v
+                    await resp.prepare(request)
+                    while True:
+                        try:
+                            chunk = await upstream.content.readany()
+                        except aiohttp.ClientError as e:
+                            # Upstream died mid-stream: the replica is suspect;
+                            # the client stream is already committed —
+                            # terminate it (truncation is the signal).
+                            self._count_failure(replica, e)
+                            with contextlib.suppress(Exception):
+                                await resp.write_eof()
+                            return resp
+                        if not chunk:
+                            break
+                        try:
+                            await resp.write(chunk)
+                        except (ConnectionError, aiohttp.ClientError):
+                            # CLIENT went away — not the replica's fault; no
+                            # failure accounting.
+                            return resp
+                    await resp.write_eof()
+                    return resp
+                finally:
+                    await upstream_cm.__aexit__(None, None, None)
+            finally:
+                replica.inflight -= 1
+        if last_err is not None:
+            return web.json_response(
+                {"error": {"message": f"upstream error: {last_err}",
+                           "code": 502}},
+                status=502)
+        return web.json_response(
+            {"error": {"message": "no healthy replicas", "code": 503}},
+            status=503)
+
+    def _count_failure(self, replica: Replica, err: Exception) -> None:
+        replica.consecutive_failures += 1
+        if replica.consecutive_failures >= self.fail_threshold:
+            replica.healthy = False
+            logger.warning("replica %s marked unhealthy (%s)",
+                           replica.url, err)
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    """CLI: python -m kubernetes_gpu_cluster_tpu.serving.router
+    --replicas http://pod-0:8000,http://pod-1:8000 --port 8080"""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--replicas", required=True,
+                   help="comma-separated replica base URLs")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    args = p.parse_args(argv)
+    router = Router(args.replicas.split(","))
+    web.run_app(router.build_app(), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Structured logging for the framework.
+
+The reference repo's only observability was colored bash ``log/warn/error``
+helpers (reference ``k8s_setup.sh:49-51``, ``gpu-crio-setup.sh:9-11``). Here we
+provide structured, leveled logging shared by the engine, server, and cluster
+tools, controllable via ``KGCT_LOG_LEVEL`` (mirroring the reference's debug
+knobs like ``VLLM_LOGGING_LEVEL`` / ``NVIDIA_LOG_LEVEL``,
+reference ``old_README.md:998-1002,1130``).
+"""
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)s [%(name)s] %(message)s"
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    level = os.environ.get("KGCT_LOG_LEVEL", "INFO").upper()
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
+    root = logging.getLogger("kgct")
+    root.setLevel(level)
+    root.addHandler(handler)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Return a logger namespaced under the framework root ``kgct``."""
+    _configure_root()
+    return logging.getLogger(f"kgct.{name}")

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Build (and optionally push) the two images the deploy surface references:
+#   ghcr.io/kgct/tpu-serving:<TAG>       (deploy/render.py DEFAULT_IMAGE)
+#   ghcr.io/kgct/tpu-device-plugin:<TAG> (device-plugin DaemonSet)
+#
+# Usage: docker/build.sh [--push] [--only serving|device-plugin]
+#   REGISTRY=ghcr.io/kgct TAG=v0.3.0 docker/build.sh
+#
+# The tags default to exactly what the manifests/renderer reference, so a
+# plain `docker/build.sh --push` makes the rendered deployment pullable.
+set -euo pipefail
+
+REGISTRY="${REGISTRY:-ghcr.io/kgct}"
+TAG="${TAG:-v0.3.0}"
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+PUSH=0
+ONLY=""
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --push) PUSH=1; shift ;;
+    --only) ONLY="$2"; shift 2 ;;
+    *) echo "unknown arg: $1" >&2; exit 2 ;;
+  esac
+done
+
+build() {
+  local name="$1" dockerfile="$2"
+  local image="${REGISTRY}/${name}:${TAG}"
+  echo ">> building ${image}"
+  # TPU VMs are amd64 and the libtpu wheel set has no aarch64 build — pin the
+  # platform so builds from arm64 hosts (Apple Silicon) produce a usable image.
+  docker build --platform linux/amd64 \
+    -f "${REPO_ROOT}/docker/${dockerfile}" -t "${image}" "${REPO_ROOT}"
+  if [[ "${PUSH}" == 1 ]]; then
+    echo ">> pushing ${image}"
+    docker push "${image}"
+  fi
+}
+
+[[ -z "${ONLY}" || "${ONLY}" == "serving" ]] && build tpu-serving Dockerfile.serving
+[[ -z "${ONLY}" || "${ONLY}" == "device-plugin" ]] && build tpu-device-plugin Dockerfile.device-plugin
+echo "done"
