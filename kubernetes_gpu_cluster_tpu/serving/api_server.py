@@ -62,7 +62,7 @@ class APIServer:
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.metrics = Metrics(engine.engine)
-        self._profile_lock = asyncio.Lock()
+        self._profile_busy = False
 
     # -- app wiring ----------------------------------------------------------
 
@@ -109,9 +109,13 @@ class APIServer:
 
         import jax
 
-        if self._profile_lock.locked():
+        # Atomic try-acquire: the flag flips synchronously (no await between
+        # test and set), so concurrent requests cannot both pass the gate and
+        # queue a second blocking capture (the check-then-acquire TOCTOU).
+        if self._profile_busy:
             return _error(409, "a profile capture is already running")
-        async with self._profile_lock:
+        self._profile_busy = True
+        try:
             seconds = float(request.query.get("seconds", 3))
             seconds = min(max(seconds, 0.1), 60.0)
             trace_dir = "/tmp/kgct-profile"
@@ -123,6 +127,8 @@ class APIServer:
                     jax.profiler.stop_trace()
                 except Exception as e:
                     return _error(500, f"profiler stop failed: {e}")
+        finally:
+            self._profile_busy = False
         return web.json_response({"trace_dir": trace_dir,
                                   "seconds": seconds})
 

@@ -117,55 +117,70 @@ class Router:
             status=status)
 
     async def metrics(self, request: web.Request) -> web.Response:
-        lines = ["# TYPE kgct_router_replica_healthy gauge",
-                 "# TYPE kgct_router_replica_inflight gauge"]
-        for r in self.replicas:
-            lines.append(f'kgct_router_replica_healthy{{replica="{r.url}"}} '
-                         f"{int(r.healthy)}")
-            lines.append(f'kgct_router_replica_inflight{{replica="{r.url}"}} '
-                         f"{r.inflight}")
+        lines = ["# TYPE kgct_router_replica_healthy gauge"]
+        lines += [f'kgct_router_replica_healthy{{replica="{r.url}"}} '
+                  f"{int(r.healthy)}" for r in self.replicas]
+        lines.append("# TYPE kgct_router_replica_inflight gauge")
+        lines += [f'kgct_router_replica_inflight{{replica="{r.url}"}} '
+                  f"{r.inflight}" for r in self.replicas]
         # Aggregate each healthy replica's engine metrics behind the single
         # front door (one scrape target for the whole DP group), labelled by
         # replica so series do not collide.
         fetched = await asyncio.gather(
             *(self._fetch_metrics(r) for r in self.replicas if r.healthy),
             return_exceptions=True)
-        # One TYPE line per metric name across ALL replicas — duplicates make
-        # the whole exposition invalid to Prometheus parsers.
-        seen_types: set[str] = set()
+        # Regroup by metric family: the text exposition format requires ONE
+        # TYPE line per family with ALL its samples contiguous — appending
+        # replicas' expositions sequentially interleaves families and strict
+        # parsers (promtool/OpenMetrics) reject the whole scrape.
+        families: dict[str, dict] = {}
         for res in fetched:
             if isinstance(res, BaseException):
                 continue
-            for kind, line in res:
-                if kind is None:
-                    lines.append(line)
-                elif kind not in seen_types:
-                    seen_types.add(kind)
-                    lines.append(line)
+            for family, is_type, line in res:
+                fam = families.setdefault(family, {"type": None, "samples": []})
+                if is_type:
+                    if fam["type"] is None:
+                        fam["type"] = line
+                else:
+                    fam["samples"].append(line)
+        for fam in families.values():
+            if fam["type"] is not None:
+                lines.append(fam["type"])
+            lines.extend(fam["samples"])
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
     async def _fetch_metrics(self, replica: Replica):
-        """Returns (metric_name_or_None, line) pairs: name set for TYPE lines
-        (deduped by the caller), None for relabelled samples."""
+        """Returns (family, is_type, line) triples with samples relabelled by
+        replica. Family attribution follows the exposition's own ordering —
+        a TYPE line opens a family and subsequent samples whose base name is
+        the family (or family + ``_suffix``, the summary/histogram
+        ``_sum``/``_count``/``_bucket`` children) belong to it."""
         async with self._session.get(f"{replica.url}/metrics",
                                      timeout=aiohttp.ClientTimeout(total=5)
                                      ) as resp:
             text = await resp.text()
         label = f'replica="{replica.url}"'
         out = []
+        current = None
         for line in text.splitlines():
             if not line or line.startswith("#"):
                 if line.startswith("# TYPE"):
                     parts = line.split()
-                    out.append((parts[2] if len(parts) > 2 else line, line))
+                    current = parts[2] if len(parts) > 2 else line
+                    out.append((current, True, line))
                 continue
             name, _, rest = line.partition(" ")
+            base = name.partition("{")[0]
+            family = (current if current and
+                      (base == current or base.startswith(current + "_"))
+                      else base)
             if "{" in name:
-                base, _, labels = name.partition("{")
-                out.append((None, f"{base}{{{label},{labels} {rest}"))
+                labels = name.partition("{")[2]
+                out.append((family, False, f"{base}{{{label},{labels} {rest}"))
             else:
-                out.append((None, f"{name}{{{label}}} {rest}"))
+                out.append((family, False, f"{base}{{{label}}} {rest}"))
         return out
 
     # -- proxying ------------------------------------------------------------

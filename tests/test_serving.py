@@ -188,6 +188,37 @@ class TestAPIServer:
         assert int(gen[0].split()[-1]) > 0   # previous tests generated tokens
 
 
+def _assert_valid_exposition(text: str) -> None:
+    """Prometheus text-format validity as strict parsers enforce it: at most
+    one TYPE line per metric family, and all of a family's samples contiguous
+    (a family's block ends as soon as another family's line appears)."""
+    closed: set[str] = set()
+    current = None
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE"):
+            fam = line.split()[2]
+            assert fam not in closed and fam != current, (
+                f"duplicate TYPE for family {fam}")
+            if current is not None:
+                closed.add(current)
+            current = fam
+            continue
+        if line.startswith("#"):
+            continue
+        base = line.partition("{")[0].partition(" ")[0]
+        fam = (current if current is not None and
+               (base == current or base.startswith(current + "_"))
+               else base)
+        if fam != current:
+            if current is not None:
+                closed.add(current)
+            current = fam
+        assert fam not in closed, (
+            f"samples of family {fam} are not contiguous: {line!r}")
+
+
 class TestRouter:
     def test_routes_and_failover(self, api_client):
         loop, client = api_client
@@ -213,7 +244,9 @@ class TestRouter:
                 data = await r.json()
                 assert data["choices"][0]["text"] is not None
                 r = await rclient.get("/metrics")
-                assert "kgct_router_replica_healthy" in await r.text()
+                text = await r.text()
+                assert "kgct_router_replica_healthy" in text
+                _assert_valid_exposition(text)
             finally:
                 await rclient.close()
         loop.run_until_complete(go())
