@@ -79,8 +79,46 @@ def check_prefill() -> None:
     assert err < 0.06, err
 
 
+def check_prefill_history() -> None:
+    # TinyLlama geometry, 512-token chunk over 3.5 pages of history.
+    from kubernetes_gpu_cluster_tpu.ops.attention import (
+        prefill_history_attention_xla)
+    from kubernetes_gpu_cluster_tpu.ops.pallas.flash_prefill_hist import (
+        flash_prefill_history)
+
+    T, nh, n_kv, hd, ps, pps, L = 512, 32, 4, 64, 128, 8, 2
+    hist_len = 3 * ps + 70
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((T, nh, hd)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((T, n_kv, hd)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((T, n_kv, hd)), jnp.bfloat16)
+    pad = 32
+    seg = jnp.asarray(np.where(np.arange(T) < T - pad, 0, -1), jnp.int32)
+    pos = jnp.asarray(np.where(np.arange(T) < T - pad,
+                               hist_len + np.arange(T), 0), jnp.int32)
+    pool_k = jnp.asarray(rng.standard_normal((L, 1 + pps, ps, n_kv * hd)),
+                         jnp.bfloat16)
+    pool_v = jnp.asarray(rng.standard_normal((L, 1 + pps, ps, n_kv * hd)),
+                         jnp.bfloat16)
+    pt = jnp.asarray(1 + np.arange(pps), jnp.int32)
+    hl = jnp.asarray(hist_len, jnp.int32)
+    scale = hd ** -0.5
+    layer = jnp.asarray(1, jnp.int32)
+
+    ref = prefill_history_attention_xla(q, k, v, seg, pos, pool_k, pool_v,
+                                        pt, hl, scale, layer=layer)
+    fn = jax.jit(lambda *a: flash_prefill_history(*a, scale, layer=layer))
+    out = fn(q, k, v, seg, pos, pool_k, pool_v, pt, hl)
+    mask = np.asarray(seg) >= 0
+    err = float(jnp.max(jnp.abs((out.astype(jnp.float32)
+                                 - ref.astype(jnp.float32))[mask])))
+    print(f"prefill_history: max|pallas-xla| = {err:.4f}")
+    assert err < 0.06, err
+
+
 if __name__ == "__main__":
     print("backend:", jax.default_backend())
     check_decode()
     check_prefill()
+    check_prefill_history()
     print("OK")

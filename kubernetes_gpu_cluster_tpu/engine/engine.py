@@ -178,9 +178,9 @@ class LLMEngine:
         # wrappers (ops.attention.*_tp) for GSPMD serving, or the pipeline's
         # own shard_map body for pp>1 — so the probe compiles the kernel at
         # the PER-SHARD head geometry each device will actually build.
-        return self._probe_pallas_compile(tp)
+        return self._probe_pallas_compile(tp, probe_hist=self.mesh is None)
 
-    def _probe_pallas_compile(self, tp: int = 1) -> bool:
+    def _probe_pallas_compile(self, tp: int = 1, probe_hist: bool = True) -> bool:
         """Compile one tiny call of EACH Pallas kernel ON THE REAL CHIP before
         committing to the Pallas path. Mosaic layout constraints surface only
         at jit-compile time (round-2 postmortem: the static lane check passed,
@@ -238,6 +238,24 @@ class LLMEngine:
                 "Pallas prefill kernel failed probe compile (%s); "
                 "falling back to XLA attention", e)
             return False
+        if probe_hist:
+            # The history-prefill kernel serves only the meshless path (the
+            # dispatcher keeps XLA under meshes — the gate here must match
+            # _build_prefill_hist_fn's, or a mesh engine would disable ALL
+            # Pallas over a kernel it never runs) but compiles lazily at the
+            # first long prompt — probe it now so a Mosaic failure surfaces
+            # at init, not mid-serving.
+            from ..ops.pallas.flash_prefill_hist import flash_prefill_history
+            try:
+                jax.jit(lambda *a: flash_prefill_history(
+                    *a, scale, layer=jnp.zeros((), jnp.int32))).lower(
+                        qf, kf, kf, seg, pos, pool, pool,
+                        tables[0], jnp.ones((), jnp.int32)).compile()
+            except Exception as e:
+                logger.warning(
+                    "Pallas history-prefill kernel failed probe compile (%s);"
+                    " falling back to XLA attention", e)
+                return False
         return True
 
     def _gspmd_attn_mesh(self):
@@ -315,8 +333,11 @@ class LLMEngine:
         """Chunked-prefill step: one sequence's chunk attending to its pool
         history (models.forward_prefill_hist). Extra inputs vs prefill:
         page_table [1, pages_bucket] and hist_len scalar. Compiled lazily —
-        engines that never see a long prompt never pay for it."""
+        engines that never see a long prompt never pay for it. Under a mesh
+        (pp or GSPMD) the dispatcher keeps the XLA path (pool lane sharding;
+        see ops.attention.prefill_history_attention)."""
         cfg = self.model_config
+        use_pallas = self.use_pallas and self.mesh is None
 
         def prefill_hist_step(params, kv: KVCache, int_t, int_b, float_b,
                               page_table, hist_len, key):
@@ -324,7 +345,8 @@ class LLMEngine:
                                slot_mapping=int_t[3],
                                logits_indices=int_b[:, 0])
             hidden, kv = model_lib.forward_prefill_hist(
-                params, cfg, int_t[0], meta, kv, page_table[0], hist_len)
+                params, cfg, int_t[0], meta, kv, page_table[0], hist_len,
+                use_pallas=use_pallas)
             logits = model_lib.compute_logits(params, cfg, hidden)
             next_tokens = sample_tokens(logits, key, float_b[:, 0],
                                         int_b[:, 1], float_b[:, 1])

@@ -35,7 +35,7 @@ from ..ops.attention import (
     write_kv_pages_all,
     ragged_prefill_attention,
     ragged_prefill_attention_tp,
-    prefill_history_attention_xla,
+    prefill_history_attention,
     paged_decode_attention,
     paged_decode_attention_tp,
 )
@@ -384,17 +384,19 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
 def forward_prefill_hist(params: Params, cfg: ModelConfig, tokens: jax.Array,
                          meta: PrefillMeta, kv: KVCache,
-                         page_table: jax.Array, hist_len: jax.Array):
+                         page_table: jax.Array, hist_len: jax.Array,
+                         use_pallas=None):
     """Chunked prefill: one sequence's chunk attending to its pool history +
-    itself causally (ops.attention.prefill_history_attention_xla). Returns
+    itself causally (ops.attention.prefill_history_attention). Returns
     (normed_selected [1, d], new_kv)."""
     scale = cfg.head_dim ** -0.5
     h = params["embed"][tokens]
 
     def attn_fn(lp, q, k, v, layer_idx):
-        return prefill_history_attention_xla(
+        return prefill_history_attention(
             q, k, v, meta.seg_ids, meta.positions, kv.k, kv.v,
-            page_table, hist_len, scale, layer=layer_idx)
+            page_table, hist_len, scale, layer=layer_idx,
+            use_pallas=use_pallas)
 
     h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn)
     new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,

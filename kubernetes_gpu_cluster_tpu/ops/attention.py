@@ -252,6 +252,32 @@ def ragged_prefill_attention(q, k, v, seg_ids, positions, scale, *,
     return ragged_prefill_attention_xla(q, k, v, seg_ids, positions, scale)
 
 
+def prefill_history_attention(q, k, v, seg_ids, positions, k_pool, v_pool,
+                              page_table, hist_len, scale, *, layer=None,
+                              use_pallas=None, strict=False):
+    """Chunked-prefill dispatcher: Pallas flash kernel on TPU (streams only
+    the valid history pages), XLA gather fallback elsewhere. Single-device /
+    shard_map-manual paths only — under a GSPMD mesh callers keep the XLA
+    implementation (the pool's lane sharding would need a tp wrapper; chunked
+    prefill is rare enough that the mesh path stays on the fallback)."""
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if use_pallas:
+        try:
+            from .pallas.flash_prefill_hist import flash_prefill_history
+            return flash_prefill_history(q, k, v, seg_ids, positions,
+                                         k_pool, v_pool, page_table,
+                                         hist_len, scale, layer=layer)
+        except Exception as e:  # pragma: no cover - fallback safety
+            if strict:
+                raise
+            logger.warning("pallas history prefill unavailable (%s); "
+                           "falling back to XLA", e)
+    return prefill_history_attention_xla(q, k, v, seg_ids, positions,
+                                         k_pool, v_pool, page_table,
+                                         hist_len, scale, layer=layer)
+
+
 def paged_decode_attention(q, k_cache_l, v_cache_l, page_tables, context_lens,
                            k_cur, v_cur, scale, *, layer=None,
                            use_pallas=None, strict=False):

@@ -209,3 +209,68 @@ class TestPallasUnderMesh:
             model_lib.paged_decode_attention_tp = orig
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
+
+
+class TestFlashPrefillHistory:
+    """flash_prefill_history vs prefill_history_attention_xla — the chunked
+    prefill kernel (history pages streamed via page-table index maps + flat
+    causal chunk phase)."""
+
+    def _mk(self, T, hist_len, nh=4, nkv=2, hd=32, ps=8, pps=4, L=2,
+            pad=0, seed=0):
+        from kubernetes_gpu_cluster_tpu.ops.attention import (
+            prefill_history_attention_xla)
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.standard_normal((T, nh, hd)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((T, nkv, hd)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((T, nkv, hd)), jnp.float32)
+        seg = jnp.asarray(
+            np.where(np.arange(T) < T - pad, 0, -1), jnp.int32)
+        pos = jnp.asarray(
+            np.where(np.arange(T) < T - pad,
+                     hist_len + np.arange(T), 0), jnp.int32)
+        pool_k = jnp.asarray(
+            rng.standard_normal((L, 1 + pps, ps, nkv * hd)), jnp.float32)
+        pool_v = jnp.asarray(
+            rng.standard_normal((L, 1 + pps, ps, nkv * hd)), jnp.float32)
+        pt = jnp.asarray(1 + np.arange(pps), jnp.int32)
+        return (q, k, v, seg, pos, pool_k, pool_v, pt,
+                jnp.asarray(hist_len, jnp.int32), hd ** -0.5,
+                prefill_history_attention_xla)
+
+    @pytest.mark.parametrize("T,hist_len,pad", [
+        (16, 0, 0),     # first chunk: no history at all
+        (16, 13, 0),    # partial page history
+        (16, 32, 4),    # full pages + tail padding
+        (32, 20, 7),    # multi-qblock with blocks smaller than T
+    ])
+    def test_matches_xla(self, T, hist_len, pad):
+        from kubernetes_gpu_cluster_tpu.ops.pallas.flash_prefill_hist import (
+            flash_prefill_history)
+        (q, k, v, seg, pos, pk, pv, pt, hl, scale, oracle) = self._mk(
+            T, hist_len, pad=pad)
+        for layer in range(2):
+            ref = oracle(q, k, v, seg, pos, pk, pv, pt, hl, scale,
+                         layer=jnp.asarray(layer))
+            got = flash_prefill_history(q, k, v, seg, pos, pk, pv, pt, hl,
+                                        scale, layer=jnp.asarray(layer),
+                                        block_q=8, block_k=8, interpret=True)
+            mask = np.asarray(seg) >= 0
+            np.testing.assert_allclose(np.asarray(got)[mask],
+                                       np.asarray(ref)[mask],
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_flat_pool_and_jit(self):
+        """3-D (single-layer) pool path, under jit with a traced hist_len."""
+        from kubernetes_gpu_cluster_tpu.ops.pallas.flash_prefill_hist import (
+            flash_prefill_history)
+        (q, k, v, seg, pos, pk, pv, pt, hl, scale, oracle) = self._mk(
+            16, 11, pad=2, seed=3)
+        ref = oracle(q, k, v, seg, pos, pk[0], pv[0], pt, hl, scale)
+        fn = jax.jit(lambda *a: flash_prefill_history(
+            *a, scale, block_q=8, block_k=8, interpret=True))
+        got = fn(q, k, v, seg, pos, pk[0], pv[0], pt, hl)
+        mask = np.asarray(seg) >= 0
+        np.testing.assert_allclose(np.asarray(got)[mask],
+                                   np.asarray(ref)[mask],
+                                   rtol=2e-5, atol=2e-5)
