@@ -87,6 +87,19 @@ class LLMEngine:
         self.eos_token_id = eos_token_id
         self.mesh = mesh
         self.pp_size = mesh.shape.get("pp", 1) if mesh is not None else 1
+        self.sp_size = mesh.shape.get("sp", 1) if mesh is not None else 1
+        if self.sp_size > 1:
+            # Sequence parallelism scales PREFILL (ring attention over sp);
+            # decode runs GSPMD with the batch replicated over sp. The
+            # pipeline composes with tp/ep, not sp (two shard_map regimes).
+            if self.pp_size > 1:
+                raise ValueError("sp and pp cannot combine in one mesh")
+            bad = [b for b in config.scheduler.prefill_buckets
+                   if b % self.sp_size]
+            if bad:
+                raise ValueError(
+                    f"prefill buckets {bad} not divisible by sp={self.sp_size}"
+                    " (ring attention shards the token axis)")
         self.use_pallas = self._resolve_use_pallas(use_pallas)
         self._key = jax.random.key(config.seed)
 
@@ -155,8 +168,17 @@ class LLMEngine:
         mesh sharding, lane alignment. Mosaic constraint violations surface at
         jit-COMPILE time, after tracing succeeded, so the dispatchers' trace-
         time try/except cannot catch them; deciding eagerly avoids a crash
-        deep in the first step."""
+        deep in the first step.
+
+        Probe granularity matches what the configured engine actually runs:
+        the decode kernel gates everything (every path decodes); the ragged-
+        prefill kernel is probed unless sp>1 (ring attention replaces it);
+        the history-prefill kernel has its OWN flag (self.use_pallas_hist,
+        meshless engines only) so a hist-only Mosaic failure costs just the
+        rare chunked-prefill fast path, not the 1.7-1.9x decode speedup."""
+        self.use_pallas_hist = False
         if use_pallas is not None:
+            self.use_pallas_hist = use_pallas and self.mesh is None
             return use_pallas
         if jax.default_backend() != "tpu":
             return False
@@ -176,86 +198,99 @@ class LLMEngine:
             return False
         # Under a mesh the kernels run per-shard inside shard_map — the tp
         # wrappers (ops.attention.*_tp) for GSPMD serving, or the pipeline's
-        # own shard_map body for pp>1 — so the probe compiles the kernel at
+        # own shard_map body for pp>1 — so the probes compile the kernels at
         # the PER-SHARD head geometry each device will actually build.
-        return self._probe_pallas_compile(tp, probe_hist=self.mesh is None)
+        if not self._probe_pallas_compile(tp):
+            return False
+        if self.mesh is None:
+            self.use_pallas_hist = self._probe_hist_compile()
+        return True
 
-    def _probe_pallas_compile(self, tp: int = 1, probe_hist: bool = True) -> bool:
-        """Compile one tiny call of EACH Pallas kernel ON THE REAL CHIP before
-        committing to the Pallas path. Mosaic layout constraints surface only
-        at jit-compile time (round-2 postmortem: the static lane check passed,
-        the kernel did not compile, and the engine had no fallback), so the
-        only reliable gate is an actual compile at this model's head geometry
-        (divided by tp: the per-shard geometry under a mesh). Both kernels
-        must pass: under a mesh the tp wrappers call them with no runtime
-        fallback, so a prefill-only Mosaic failure would otherwise crash the
-        first serving step. ~2s for the tiny shapes, paid once per engine
-        construction (serving builds one engine per process)."""
+    def _probe_shapes(self, tp: int):
+        """Tiny probe inputs at the per-shard head geometry. pps >= the
+        decode kernel's DERIVED chunk_pages (max(1, 128 // page_size)): the
+        kernel caps its chunk at min(chunk_pages, pps), so a probe with
+        smaller pps would compile a different (smaller-scratch) kernel than
+        serving runs and could pass while the real configuration fails.
+        pps=8 covers the derivation for every page_size >= 16. The pool is
+        stacked [L, P, ps, kd] with a dynamic layer index — the variant
+        serving actually runs."""
+        cfg = dataclasses.replace(
+            self.model_config,
+            num_heads=self.model_config.num_heads // tp,
+            num_kv_heads=self.model_config.num_kv_heads // tp)
+        ps = self.config.cache.page_size
+        B, pps, T = 4, 8, 128
+        kd = cfg.num_kv_heads * cfg.head_dim
+        return dict(
+            cfg=cfg, scale=cfg.head_dim ** -0.5,
+            q=jnp.zeros((B, cfg.num_heads, cfg.head_dim), cfg.jnp_dtype),
+            pool=jnp.zeros((2, 2, ps, kd), cfg.jnp_dtype),
+            tables=jnp.zeros((B, pps), jnp.int32),
+            ctx=jnp.ones((B,), jnp.int32),
+            cur=jnp.zeros((B, cfg.num_kv_heads, cfg.head_dim), cfg.jnp_dtype),
+            qf=jnp.zeros((T, cfg.num_heads, cfg.head_dim), cfg.jnp_dtype),
+            kf=jnp.zeros((T, cfg.num_kv_heads, cfg.head_dim), cfg.jnp_dtype),
+            seg=jnp.zeros((T,), jnp.int32),
+            pos=jnp.arange(T, dtype=jnp.int32))
+
+    def _probe_pallas_compile(self, tp: int = 1) -> bool:
+        """Compile one tiny call of the decode kernel — and, unless ring
+        attention replaces it (sp>1), the ragged-prefill kernel — ON THE REAL
+        CHIP before committing to the Pallas path. Mosaic layout constraints
+        surface only at jit-compile time (round-2 postmortem: the static lane
+        check passed, the kernel did not compile, and the engine had no
+        fallback), so the only reliable gate is an actual compile. Under a
+        mesh the tp wrappers call the kernels with no runtime fallback, so
+        both probed kernels must pass. ~2s for the tiny shapes, paid once
+        per engine construction (serving builds one engine per process)."""
         from ..ops.pallas.flash_prefill import flash_ragged_prefill
         from ..ops.pallas.paged_decode import pallas_paged_decode
 
-        cfg = self.model_config
-        cfg = dataclasses.replace(cfg, num_heads=cfg.num_heads // tp,
-                                  num_kv_heads=cfg.num_kv_heads // tp)
-        ps = self.config.cache.page_size
-        # pps >= the kernel's DERIVED chunk_pages (max(1, 128 // page_size),
-        # see pallas_paged_decode): the kernel caps its chunk at
-        # min(chunk_pages, pps), so a probe with smaller pps would compile a
-        # different (smaller-scratch) kernel than serving runs and could pass
-        # while the real configuration fails. pps=8 covers the derivation for
-        # every page_size >= 16.
-        B, pps = 4, 8
-        kd = cfg.num_kv_heads * cfg.head_dim
-        scale = cfg.head_dim ** -0.5
-        q = jnp.zeros((B, cfg.num_heads, cfg.head_dim), cfg.jnp_dtype)
-        # Stacked [L, P, ps, kd] pool + dynamic layer index — the variant
-        # serving actually runs (a flat layer=None probe would exercise a
-        # different addressing pattern than the decode scan's
-        # k_hbm.at[layer_ref[0], page]).
-        pool = jnp.zeros((2, 2, ps, kd), cfg.jnp_dtype)
-        tables = jnp.zeros((B, pps), jnp.int32)
-        ctx = jnp.ones((B,), jnp.int32)
-        cur = jnp.zeros((B, cfg.num_kv_heads, cfg.head_dim), cfg.jnp_dtype)
+        s = self._probe_shapes(tp)
+        scale = s["scale"]
         try:
             jax.jit(lambda *a: pallas_paged_decode(
                 *a, scale, layer=jnp.zeros((1,), jnp.int32))).lower(
-                    q, pool, pool, tables, ctx, cur, cur).compile()
+                    s["q"], s["pool"], s["pool"], s["tables"], s["ctx"],
+                    s["cur"], s["cur"]).compile()
         except Exception as e:  # Mosaic errors are plain XlaRuntimeError
             logger.warning(
                 "Pallas decode kernel failed probe compile (%s); "
                 "falling back to XLA attention", e)
             return False
-        T = 128
-        qf = jnp.zeros((T, cfg.num_heads, cfg.head_dim), cfg.jnp_dtype)
-        kf = jnp.zeros((T, cfg.num_kv_heads, cfg.head_dim), cfg.jnp_dtype)
-        seg = jnp.zeros((T,), jnp.int32)
-        pos = jnp.arange(T, dtype=jnp.int32)
-        try:
-            jax.jit(lambda *a: flash_ragged_prefill(*a, scale)).lower(
-                qf, kf, kf, seg, pos).compile()
-        except Exception as e:
-            logger.warning(
-                "Pallas prefill kernel failed probe compile (%s); "
-                "falling back to XLA attention", e)
-            return False
-        if probe_hist:
-            # The history-prefill kernel serves only the meshless path (the
-            # dispatcher keeps XLA under meshes — the gate here must match
-            # _build_prefill_hist_fn's, or a mesh engine would disable ALL
-            # Pallas over a kernel it never runs) but compiles lazily at the
-            # first long prompt — probe it now so a Mosaic failure surfaces
-            # at init, not mid-serving.
-            from ..ops.pallas.flash_prefill_hist import flash_prefill_history
+        if self.sp_size == 1:
             try:
-                jax.jit(lambda *a: flash_prefill_history(
-                    *a, scale, layer=jnp.zeros((), jnp.int32))).lower(
-                        qf, kf, kf, seg, pos, pool, pool,
-                        tables[0], jnp.ones((), jnp.int32)).compile()
+                jax.jit(lambda *a: flash_ragged_prefill(*a, scale)).lower(
+                    s["qf"], s["kf"], s["kf"], s["seg"], s["pos"]).compile()
             except Exception as e:
                 logger.warning(
-                    "Pallas history-prefill kernel failed probe compile (%s);"
-                    " falling back to XLA attention", e)
+                    "Pallas prefill kernel failed probe compile (%s); "
+                    "falling back to XLA attention", e)
                 return False
+        return True
+
+    def _probe_hist_compile(self) -> bool:
+        """The history-prefill kernel serves only the meshless path (the
+        dispatcher keeps XLA under meshes) and compiles lazily at the first
+        long prompt — probe it at init so a Mosaic failure surfaces here and
+        disables ONLY the chunked-prefill fast path (the XLA fallback is
+        correct, and decode keeps its kernels)."""
+        from ..ops.pallas.flash_prefill_hist import flash_prefill_history
+
+        s = self._probe_shapes(tp=1)
+        scale = s["scale"]
+        try:
+            jax.jit(lambda *a: flash_prefill_history(
+                *a, scale, layer=jnp.zeros((), jnp.int32))).lower(
+                    s["qf"], s["kf"], s["kf"], s["seg"], s["pos"],
+                    s["pool"], s["pool"], s["tables"][0],
+                    jnp.ones((), jnp.int32)).compile()
+        except Exception as e:
+            logger.warning(
+                "Pallas history-prefill kernel failed probe compile (%s); "
+                "chunked prefill uses the XLA path", e)
+            return False
         return True
 
     def _gspmd_attn_mesh(self):
@@ -311,6 +346,18 @@ class LLMEngine:
                         KVCache(k=kvk, v=kvv))
         else:
             attn_mesh = self._gspmd_attn_mesh()
+            attn_impl = None
+            if self.sp_size > 1:
+                # Ring attention over the sp axis (parallel/sp.py): each
+                # device holds T/sp tokens and K/V blocks rotate by ppermute.
+                # Heads stay replicated inside the ring body — sp is the
+                # long-context axis, tp the weight axis; they compose at the
+                # GSPMD level (matmuls), not inside attention.
+                from ..parallel.sp import build_ring_prefill
+                attn_impl = build_ring_prefill(
+                    self.mesh, cfg.num_kv_heads,
+                    cfg.num_heads // cfg.num_kv_heads, cfg.head_dim ** -0.5)
+                attn_mesh = None
 
             def fwd(params, kv, int_t, logits_indices):
                 meta = PrefillMeta(seg_ids=int_t[1], positions=int_t[2],
@@ -318,7 +365,7 @@ class LLMEngine:
                                    logits_indices=logits_indices)
                 hidden, kv, _ = model_lib.forward_prefill(
                     params, cfg, int_t[0], meta, kv, use_pallas=use_pallas,
-                    attn_mesh=attn_mesh)
+                    attn_mesh=attn_mesh, attn_impl=attn_impl)
                 return model_lib.compute_logits(params, cfg, hidden), kv
 
         def prefill_step(params, kv: KVCache, int_t, int_b, float_b, key):
@@ -333,11 +380,12 @@ class LLMEngine:
         """Chunked-prefill step: one sequence's chunk attending to its pool
         history (models.forward_prefill_hist). Extra inputs vs prefill:
         page_table [1, pages_bucket] and hist_len scalar. Compiled lazily —
-        engines that never see a long prompt never pay for it. Under a mesh
-        (pp or GSPMD) the dispatcher keeps the XLA path (pool lane sharding;
-        see ops.attention.prefill_history_attention)."""
+        engines that never see a long prompt never pay for it. Gated by its
+        own per-kernel flag (use_pallas_hist: meshless engines whose hist
+        probe compiled); under a mesh the dispatcher keeps the XLA path
+        (pool lane sharding; see ops.attention.prefill_history_attention)."""
         cfg = self.model_config
-        use_pallas = self.use_pallas and self.mesh is None
+        use_pallas = self.use_pallas_hist
 
         def prefill_hist_step(params, kv: KVCache, int_t, int_b, float_b,
                               page_table, hist_len, key):

@@ -352,18 +352,22 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
                     hidden_in: Optional[jax.Array] = None,
                     tp_axis: Optional[str] = None,
                     ep_axis: Optional[str] = None,
-                    attn_mesh=None):
+                    attn_mesh=None, attn_impl=None):
     """Ragged prefill over T flattened tokens. Returns (selected_hidden [B, d],
     new_kv, raw_hidden [T, d]). ``hidden_in`` replaces the embedding lookup for
     non-first pipeline stages; ``raw_hidden`` is what rotates stage-to-stage.
     ``attn_mesh``: under a GSPMD mesh, run the Pallas attention per-shard via
-    shard_map over the tp axis (ops.attention.ragged_prefill_attention_tp)."""
+    shard_map over the tp axis (ops.attention.ragged_prefill_attention_tp).
+    ``attn_impl``: full override ``fn(q, k, v, seg_ids, positions) -> out``
+    (the engine passes ring attention here for sp>1 meshes)."""
     scale = cfg.head_dim ** -0.5
     h = params["embed"][tokens] if hidden_in is None else hidden_in
 
     def attn_fn(lp, q, k, v, layer_idx):
         # Prefill attends within the in-batch k/v only (each sequence's whole
         # prompt is in this batch); the pool is written post-scan for decode.
+        if attn_impl is not None:
+            return attn_impl(q, k, v, meta.seg_ids, meta.positions)
         if attn_mesh is not None:
             return ragged_prefill_attention_tp(attn_mesh, q, k, v,
                                                meta.seg_ids, meta.positions,

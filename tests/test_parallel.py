@@ -104,6 +104,29 @@ class TestTensorParallel:
         with pytest.raises(ValueError, match="num_layers"):
             LLMEngine(cfg, mesh=make_mesh(pp=8))
 
+    def test_sp_engine_matches_single_device(self):
+        """Ring attention as a SERVING capability: the engine on an sp=4 x
+        dp=2 mesh routes prefill attention through the sp ring and must
+        greedy-decode identical tokens to the single-device engine."""
+        cfg = EngineConfig.from_model_name("debug-tiny")
+        params = model_lib.init_params(cfg.model, jax.random.key(0))
+        ref_tokens = _generate_tokens(LLMEngine(cfg, params=params))
+        eng = LLMEngine(cfg, params=params, mesh=make_mesh(sp=4, dp=2))
+        assert eng.sp_size == 4
+        assert _generate_tokens(eng) == ref_tokens
+
+    def test_sp_engine_rejects_indivisible_buckets(self):
+        from kubernetes_gpu_cluster_tpu.config import SchedulerConfig
+        cfg = EngineConfig.from_model_name(
+            "debug-tiny", scheduler=SchedulerConfig(prefill_buckets=(100,)))
+        with pytest.raises(ValueError, match="prefill buckets"):
+            LLMEngine(cfg, mesh=make_mesh(sp=8))
+
+    def test_sp_engine_rejects_pp_combination(self):
+        cfg = EngineConfig.from_model_name("debug-tiny")
+        with pytest.raises(ValueError, match="sp and pp"):
+            LLMEngine(cfg, mesh=make_mesh(sp=2, pp=2))
+
     def test_tp_rejects_indivisible_heads(self):
         cfg = get_model_config("debug-tiny")  # 4 heads
         mesh = make_mesh(tp=8)
