@@ -303,19 +303,33 @@ def render_values(values: dict) -> dict[str, dict]:
     return out
 
 
-def render_values_file(path: str) -> dict[str, dict]:
+def load_values_file(path: str) -> dict:
     with open(path) as f:
-        return render_values(yaml.safe_load(f))
+        return yaml.safe_load(f)
+
+
+def render_values_file(path: str) -> dict[str, dict]:
+    return render_values(load_values_file(path))
 
 
 def main(argv: Optional[list[str]] = None) -> None:
     """CLI: python -m kubernetes_gpu_cluster_tpu.deploy.render
-    -f values.yaml -o manifests/   (then: kubectl apply -f manifests/)"""
+    -f values.yaml -o manifests/         (then: kubectl apply -f manifests/)
+    -f values.yaml --emit-chart chart/   (then: helm install kgct chart/)"""
     p = argparse.ArgumentParser()
     p.add_argument("-f", "--values", required=True)
     p.add_argument("-o", "--out-dir", default=None,
                    help="write one YAML per manifest; default: print stream")
+    p.add_argument("--emit-chart", metavar="DIR", default=None,
+                   help="write an installable Helm chart (deploy/chart.py): "
+                        "helm install/upgrade/rollback then manage releases")
     args = p.parse_args(argv)
+    if args.emit_chart:
+        from .chart import emit_chart
+        files = emit_chart(load_values_file(args.values), args.emit_chart)
+        print(f"wrote chart ({len(files)} files) to {args.emit_chart}")
+        if not args.out_dir:
+            return
     manifests = render_values_file(args.values)
     if args.out_dir:
         os.makedirs(args.out_dir, exist_ok=True)
