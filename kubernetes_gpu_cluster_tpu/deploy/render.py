@@ -324,13 +324,14 @@ def main(argv: Optional[list[str]] = None) -> None:
                    help="write an installable Helm chart (deploy/chart.py): "
                         "helm install/upgrade/rollback then manage releases")
     args = p.parse_args(argv)
+    values = load_values_file(args.values)
     if args.emit_chart:
         from .chart import emit_chart
-        files = emit_chart(load_values_file(args.values), args.emit_chart)
+        files = emit_chart(values, args.emit_chart)
         print(f"wrote chart ({len(files)} files) to {args.emit_chart}")
         if not args.out_dir:
             return
-    manifests = render_values_file(args.values)
+    manifests = render_values(values)
     if args.out_dir:
         os.makedirs(args.out_dir, exist_ok=True)
         for fname, manifest in sorted(manifests.items()):

@@ -47,6 +47,7 @@ def _decode_kernel(
     page_tables_ref,   # [B*pps] int32 (flattened)
     context_lens_ref,  # [B] int32 (incl. current token)
     layer_ref,         # [1] int32 layer index into the pool
+    offsets_ref,       # [B+1] int32 cumulative chunk counts (global stream)
     # blocked inputs
     q_ref,             # [1, nh, hd] VMEM
     k_hbm,             # [L, P, ps, n_kv*hd] ANY/HBM (full pool, heads flat)
@@ -68,6 +69,7 @@ def _decode_kernel(
     head_dim: int,
     chunk_pages: int,
     num_bufs: int,
+    num_seqs: int,
 ):
     NBUF = num_bufs
     b = pl.program_id(0)
@@ -78,19 +80,39 @@ def _decode_kernel(
     ctx_pool = jnp.maximum(context_lens_ref[b] - 1, 0)  # tokens already in pool
     n_pages = pl.cdiv(ctx_pool, ps)
     n_chunks = pl.cdiv(n_pages, C)
+    g0 = offsets_ref[b]
 
-    def start_chunk(c, slot):
-        # DMA all C pages of chunk c concurrently. Pages past n_pages read the
-        # table's padding entries (scrap page 0) — valid memory, masked later.
+    # Chunks form ONE GLOBAL STREAM across the whole batch (gid in
+    # [0, offsets[B])), prefetched NBUF-1 ahead with slots keyed by gid —
+    # so a sequence's first page DMA is issued during the PREVIOUS
+    # sequence's compute instead of stalling its own grid step (the
+    # measured bottleneck: at 128-token pages most sequences are 1-2
+    # chunks, so per-sequence warmup exposed a full DMA latency per grid
+    # step; cross-sequence lookahead hides it).
+
+    def _start(s, lc, slot):
+        # DMA all C pages of sequence s's chunk lc. Pages past that
+        # sequence's n_pages read the table's padding entries (scrap page
+        # 0) — valid memory, masked later.
         for j in range(C):
-            idx = jnp.minimum(c * C + j, pages_per_seq - 1)
-            page = page_tables_ref[b * pages_per_seq + idx]
+            idx = jnp.minimum(lc * C + j, pages_per_seq - 1)
+            page = page_tables_ref[s * pages_per_seq + idx]
             pltpu.make_async_copy(
                 k_hbm.at[layer_ref[0], page], k_buf.at[slot, j],
                 sems.at[slot, 0, j]).start()
             pltpu.make_async_copy(
                 v_hbm.at[layer_ref[0], page], v_buf.at[slot, j],
                 sems.at[slot, 1, j]).start()
+
+    def start_global(gid):
+        # Map a global chunk id to (sequence, local chunk) by scanning the
+        # offsets forward from the current sequence (cheap SMEM reads;
+        # zero-chunk sequences are skipped by construction).
+        @pl.when(gid < offsets_ref[num_seqs])
+        def _():
+            s = jax.lax.while_loop(
+                lambda s: offsets_ref[s + 1] <= gid, lambda s: s + 1, b)
+            _start(s, gid - offsets_ref[s], jax.lax.rem(gid, NBUF))
 
     def wait_chunk(c, slot):
         for j in range(C):
@@ -103,13 +125,13 @@ def _decode_kernel(
                 v_hbm.at[layer_ref[0], page], v_buf.at[slot, j],
                 sems.at[slot, 1, j]).wait()
 
-    # Prefetch pipeline depth NBUF: chunks c..c+NBUF-1 stream concurrently.
-    # At ~45ns issue + ~µs completion latency per DMA, a depth-1 double
-    # buffer leaves the sparse core waiting between small chunks.
-    for d in range(NBUF - 1):
-        @pl.when(d < n_chunks)
-        def _(d=d):
-            start_chunk(d, d)
+    # Stream warmup: the first NBUF-1 global chunks (first grid step only).
+    # Every later gid is started by the iteration of gid-(NBUF-1), wherever
+    # in the batch that iteration lives — each gid starts exactly once.
+    @pl.when(b == 0)
+    def _():
+        for d in range(NBUF - 1):
+            start_global(jnp.int32(d))
 
     # Block-diagonal query: Qbd[h, kh*hd:(kh+1)*hd] = q[h] iff kh == h // g.
     # Built reshape-free: tile q across kv blocks with one MXU matmul against
@@ -133,11 +155,10 @@ def _decode_kernel(
 
     def body(c, carry):
         m, l, acc = carry
-        slot = jax.lax.rem(c, NBUF)
+        gid = g0 + c
+        slot = jax.lax.rem(gid, NBUF)
 
-        @pl.when(c + NBUF - 1 < n_chunks)
-        def _():
-            start_chunk(c + NBUF - 1, jax.lax.rem(c + NBUF - 1, NBUF))
+        start_global(gid + NBUF - 1)
 
         wait_chunk(c, slot)
         kk = k_buf[slot].reshape(C * ps, kd).astype(jnp.float32)
@@ -184,7 +205,7 @@ def _decode_kernel(
 
 def pallas_paged_decode(q, k_pool, v_pool, page_tables, context_lens,
                         k_cur, v_cur, scale, *, layer=None, interpret=False,
-                        chunk_pages=None, num_bufs=2):
+                        chunk_pages=None, num_bufs=None):
     """q: [B, nh, hd]; k_pool/v_pool: [P, ps, n_kv*hd] (one layer, heads
     flattened) or [L, P, ps, n_kv*hd] with ``layer`` the dynamic layer index;
     page_tables: [B, pages_per_seq]; context_lens: [B] (incl. current token);
@@ -226,17 +247,35 @@ def pallas_paged_decode(q, k_pool, v_pool, page_tables, context_lens,
     k_cur = k_cur.reshape(B, 1, n_kv * hd)
     v_cur = v_cur.reshape(B, 1, n_kv * hd)
 
-    # Prefetch depth: with C pages in flight per buffer slot, NBUF slots keep
-    # NBUF*C page DMAs outstanding. Clamp to the worst-case chunk count —
-    # slots beyond ceil(pps/C) could never be in flight simultaneously and
-    # would only waste VMEM. num_bufs=1 is the serial (no-prefetch) baseline.
-    NBUF = max(1, min(int(num_bufs), -(-pps // C)))
+    # Prefetch depth: NBUF slots keep up to NBUF-1 chunks of the GLOBAL
+    # cross-sequence stream in flight ahead of compute (do NOT clamp to one
+    # sequence's chunk count — the lookahead deliberately crosses sequence
+    # boundaries). num_bufs=1 is the serial baseline; KGCT_DECODE_NBUF
+    # overrides for A/B (bench-measured: 2 best; 4/8 slower — each slot
+    # costs 2*C*ps*n_kv*hd bytes of VMEM, capped below so an env override
+    # fails loudly here rather than as an opaque Mosaic error).
+    if num_bufs is None:
+        import os
+        num_bufs = int(os.environ.get("KGCT_DECODE_NBUF", "2"))
+    NBUF = max(1, int(num_bufs))
+    slot_bytes = 2 * C * ps * n_kv * hd * k_pool.dtype.itemsize
+    if NBUF * slot_bytes > 8 * 1024 * 1024:
+        raise ValueError(
+            f"num_bufs={NBUF} needs {NBUF * slot_bytes} bytes of VMEM "
+            f"scratch (> 8 MiB budget); lower KGCT_DECODE_NBUF")
+    # Global chunk stream: cumulative per-sequence chunk counts, so the
+    # kernel prefetches ACROSS sequence boundaries (gid -> (seq, chunk)).
+    n_chunks_per_seq = jnp.ceil(
+        jnp.maximum(context_lens - 1, 0) / (C * ps)).astype(jnp.int32)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(n_chunks_per_seq)])
     kernel = functools.partial(
         _decode_kernel, scale=float(scale), pages_per_seq=pps, page_size=ps,
-        num_kv=n_kv, q_per_kv=g, head_dim=hd, chunk_pages=C, num_bufs=NBUF)
+        num_kv=n_kv, q_per_kv=g, head_dim=hd, chunk_pages=C, num_bufs=NBUF,
+        num_seqs=B)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, nh, hd), lambda b, *_: (b, 0, 0),
@@ -261,5 +300,5 @@ def pallas_paged_decode(q, k_pool, v_pool, page_tables, context_lens,
         out_shape=jax.ShapeDtypeStruct((B, nh, hd), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(page_tables.reshape(-1), context_lens, layer, q, k_pool, v_pool,
-      k_cur, v_cur)
+    )(page_tables.reshape(-1), context_lens, layer, offsets, q, k_pool,
+      v_pool, k_cur, v_cur)
