@@ -60,6 +60,11 @@ PAGE = (int(os.environ["KGCT_BENCH_PAGE"])
 # host-RT amortization buys back, and push contexts longer for the same
 # token budget).
 DECODE_WINDOW = int(os.environ.get("KGCT_BENCH_WINDOW", 32))
+# Prefill token budget per step. 2048 is the MEASURED operating point:
+# bigger steps save tunnel round trips but lose more to the O(T^2) flash
+# prefill grid (8192-token steps measured ~2x worse p50 TTFT — see
+# PARITY.md "TTFT lever tried").
+PREFILL_BUDGET = int(os.environ.get("KGCT_BENCH_PREFILL_BUDGET", 2048))
 WARMUP_WINDOWS = 3
 BENCH_WINDOWS = int(os.environ.get("KGCT_BENCH_WINDOWS", 12))
 MAX_NEW_TOKENS = PROMPT_LEN + DECODE_WINDOW * (WARMUP_WINDOWS + BENCH_WINDOWS + 4)
@@ -101,8 +106,8 @@ def main() -> None:
         model=get_model_config(model_name).replace(quantization=quant),
         cache=CacheConfig(page_size=page, num_pages=BATCH * pages_per_seq + 1),
         scheduler=SchedulerConfig(
-            max_num_seqs=BATCH, max_prefill_tokens=2048,
-            decode_buckets=(BATCH,), prefill_buckets=(2048,),
+            max_num_seqs=BATCH, max_prefill_tokens=PREFILL_BUDGET,
+            decode_buckets=(BATCH,), prefill_buckets=(PREFILL_BUDGET,),
             decode_window=DECODE_WINDOW))
     engine = LLMEngine(cfg, eos_token_id=None)
     rng = np.random.default_rng(0)

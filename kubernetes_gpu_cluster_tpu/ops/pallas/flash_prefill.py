@@ -55,7 +55,14 @@ def _prefill_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Skip upper-triangular blocks entirely (flat-causal).
+    # Skip upper-triangular blocks entirely (flat-causal). NOTE a measured
+    # dead end (r4): adding a segment-interval skip for fully cross-segment-
+    # masked blocks here does NOT help — the BlockSpec pipeline has already
+    # scheduled the block's K/V/Q DMA by the time the kernel body runs, and
+    # this kernel is DMA-bound (p50 TTFT at one 8192-token step stayed ~2x
+    # worse than 4x2048 with the skip in place). Pruning masked blocks at
+    # the right depth means a segment-aware GRID (scalar-prefetched block
+    # ranges driving the index maps); until then, size prefill steps ~2048.
     @pl.when(j * block_k <= i * block_q + block_q - 1)
     def _():
         q = q_ref[0].astype(jnp.float32) * scale            # [BQ, hd]
