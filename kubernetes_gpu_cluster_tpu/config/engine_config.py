@@ -62,10 +62,11 @@ class ParallelConfig:
     pp: int = 1    # pipeline parallel (layer stages)
     dp: int = 1    # data parallel (replicated engine)
     ep: int = 1    # expert parallel (MoE experts)
+    sp: int = 1    # sequence parallel (ring-attention prefill, long context)
 
     @property
     def world_size(self) -> int:
-        return self.tp * self.pp * self.dp * self.ep
+        return self.tp * self.pp * self.dp * self.ep * self.sp
 
 
 @dataclasses.dataclass(frozen=True)

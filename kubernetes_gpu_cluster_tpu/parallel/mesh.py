@@ -57,7 +57,8 @@ def mesh_from_config(cfg: ParallelConfig,
     then skips all sharding annotations)."""
     if cfg.world_size == 1:
         return None
-    return make_mesh(tp=cfg.tp, pp=cfg.pp, dp=cfg.dp, ep=cfg.ep, devices=devices)
+    return make_mesh(tp=cfg.tp, pp=cfg.pp, dp=cfg.dp, ep=cfg.ep, sp=cfg.sp,
+                     devices=devices)
 
 
 def initialize_distributed(

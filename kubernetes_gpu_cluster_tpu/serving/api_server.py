@@ -325,7 +325,7 @@ def main(argv: Optional[list[str]] = None) -> None:
     import argparse
 
     from ..config import CacheConfig, ParallelConfig, get_model_config
-    from ..parallel import initialize_distributed, make_mesh
+    from ..parallel import initialize_distributed, mesh_from_config
 
     p = argparse.ArgumentParser()
     p.add_argument("--model", required=True)
@@ -338,6 +338,11 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--max-model-len", type=int, default=None)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--pipeline-parallel-size", type=int, default=1)
+    p.add_argument("--sequence-parallel-size", type=int, default=1,
+                   help="ring-attention prefill over the sp mesh axis "
+                   "(long-context scaling; beyond the reference's surface)")
+    p.add_argument("--expert-parallel-size", type=int, default=1,
+                   help="MoE expert sharding over the ep mesh axis")
     p.add_argument("--hbm-utilization", "--gpu-memory-utilization",
                    dest="hbm_utilization", type=float, default=0.90,
                    help="fraction of free HBM given to the KV page pool")
@@ -384,12 +389,17 @@ def main(argv: Optional[list[str]] = None) -> None:
             max_num_seqs=args.max_num_seqs,
             enable_prefix_caching=args.enable_prefix_caching),
         parallel=ParallelConfig(tp=args.tensor_parallel_size,
-                                pp=args.pipeline_parallel_size),
+                                pp=args.pipeline_parallel_size,
+                                sp=args.sequence_parallel_size,
+                                ep=args.expert_parallel_size),
         max_model_len=args.max_model_len,
         enforce_eager=args.enforce_eager)
-    mesh = None
-    if config.parallel.world_size > 1:
-        mesh = make_mesh(tp=config.parallel.tp, pp=config.parallel.pp)
+    if args.expert_parallel_size > 1 and not model_cfg.is_moe:
+        # ep on a dense model silently replicates all work across the axis —
+        # N chips for ~1 chip of throughput. Refuse the misconfiguration.
+        p.error(f"--expert-parallel-size {args.expert_parallel_size} "
+                f"requires an MoE model; {model_cfg.name} is dense")
+    mesh = mesh_from_config(config.parallel)
     params = None
     if args.weights:
         from ..engine.weights import load_weights

@@ -260,3 +260,16 @@ class TestPipelineParallel:
         mesh = make_mesh(pp=2, dp=4)
         with pytest.raises(ValueError, match="not divisible"):
             build_pp_forward(mesh, cfg, "decode")
+
+
+def test_parallel_config_sp_axis():
+    """--sequence-parallel-size reaches the engine: ParallelConfig carries sp
+    and mesh_from_config builds the sp mesh (serving-config reachability)."""
+    from kubernetes_gpu_cluster_tpu.config.engine_config import ParallelConfig
+    from kubernetes_gpu_cluster_tpu.parallel import mesh_from_config
+
+    cfg = ParallelConfig(sp=8)
+    assert cfg.world_size == 8
+    mesh = mesh_from_config(cfg)
+    assert mesh.shape["sp"] == 8
+    assert mesh_from_config(ParallelConfig()) is None
