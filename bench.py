@@ -60,11 +60,13 @@ PAGE = (int(os.environ["KGCT_BENCH_PAGE"])
 # host-RT amortization buys back, and push contexts longer for the same
 # token budget).
 DECODE_WINDOW = int(os.environ.get("KGCT_BENCH_WINDOW", 32))
-# Prefill token budget per step. 2048 is the MEASURED operating point:
-# bigger steps save tunnel round trips but lose more to the O(T^2) flash
-# prefill grid (8192-token steps measured ~2x worse p50 TTFT — see
-# PARITY.md "TTFT lever tried").
-PREFILL_BUDGET = int(os.environ.get("KGCT_BENCH_PREFILL_BUDGET", 2048))
+# Prefill token budget per step. 4096 (2 steps for the 64x128 batch) is the
+# measured operating point AFTER the segment-aware k-window upgrade to the
+# flash prefill kernel removed the O(T^2) masked-block DMA: p95 TTFT 649 ms
+# vs 830 at 2048 (fewer tunnel RTs), p50 equal within noise, best prefill
+# throughput (12.6k tok/s). Before the kernel fix, bigger steps LOST (see
+# PARITY.md "TTFT lever").
+PREFILL_BUDGET = int(os.environ.get("KGCT_BENCH_PREFILL_BUDGET", 4096))
 WARMUP_WINDOWS = 3
 BENCH_WINDOWS = int(os.environ.get("KGCT_BENCH_WINDOWS", 12))
 MAX_NEW_TOKENS = PROMPT_LEN + DECODE_WINDOW * (WARMUP_WINDOWS + BENCH_WINDOWS + 4)

@@ -88,6 +88,12 @@ class LLMEngine:
         self.mesh = mesh
         self.pp_size = mesh.shape.get("pp", 1) if mesh is not None else 1
         self.sp_size = mesh.shape.get("sp", 1) if mesh is not None else 1
+        ep = mesh.shape.get("ep", 1) if mesh is not None else 1
+        if ep > 1 and not config.model.is_moe:
+            # ep on a dense model would silently replicate all work across
+            # the axis — N chips for ~1 chip of throughput.
+            raise ValueError(
+                f"ep={ep} requires an MoE model; {config.model.name} is dense")
         if self.sp_size > 1:
             # Sequence parallelism scales PREFILL (ring attention over sp);
             # decode runs GSPMD with the batch replicated over sp. The

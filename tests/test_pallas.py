@@ -274,3 +274,22 @@ class TestFlashPrefillHistory:
         np.testing.assert_allclose(np.asarray(got)[mask],
                                    np.asarray(ref)[mask],
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_flash_prefill_partial_final_block():
+    """T not a multiple of block_k: the partial final K/V block's padding is
+    undefined memory (NaN in interpret mode) and must not poison real rows
+    (regression: 0*NaN in the p@v contraction NaN'd the last q block)."""
+    T, nh, nkv, hd = 300, 4, 2, 32
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.standard_normal((T, nh, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((T, nkv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((T, nkv, hd)), jnp.float32)
+    seg = jnp.asarray(np.where(np.arange(T) < 280, 0, -1), jnp.int32)
+    pos = jnp.asarray(np.where(np.arange(T) < 280, np.arange(T), 0), jnp.int32)
+    ref = ragged_prefill_attention_xla(q, k, v, seg, pos, 0.125)
+    got = flash_ragged_prefill(q, k, v, seg, pos, 0.125, interpret=True)
+    mask = np.asarray(seg) >= 0
+    assert np.isfinite(np.asarray(got)[mask]).all()
+    np.testing.assert_allclose(np.asarray(got)[mask], np.asarray(ref)[mask],
+                               rtol=2e-5, atol=2e-5)
