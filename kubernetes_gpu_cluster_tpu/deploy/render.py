@@ -51,7 +51,8 @@ import yaml
 DEFAULT_IMAGE = "ghcr.io/kgct/tpu-serving:v0.3.0"
 ENGINE_PORT = 8000
 ROUTER_PORT = 8080
-COORD_PORT = 8476
+COORD_PORT = 8476       # jax.distributed coordinator (rank 0)
+CONTROL_PORT = 8477     # step-directive channel (serving/multihost.py)
 
 _PART_OF = "kgct-stack"
 
@@ -197,10 +198,15 @@ def _render_model(spec: dict, engine: dict) -> dict[str, dict]:
             "metadata": {"name": f"kgct-{name}-engine-hl", "labels": labels},
             "spec": {
                 "clusterIP": "None",
+                # Per-pod DNS must resolve BEFORE readiness: rank 0's
+                # jax.distributed coordinator and directive connects are what
+                # MAKE the pods ready (standard StatefulSet peer discovery).
+                "publishNotReadyAddresses": True,
                 "selector": labels,
                 "ports": [
                     {"name": "http", "port": ENGINE_PORT},
                     {"name": "coordinator", "port": COORD_PORT},
+                    {"name": "directives", "port": CONTROL_PORT},
                 ],
             },
         }

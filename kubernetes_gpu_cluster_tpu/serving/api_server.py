@@ -303,10 +303,11 @@ def _error(status: int, message: str) -> web.Response:
 
 def build_server(config: EngineConfig, tokenizer_path: Optional[str] = None,
                  model_name: Optional[str] = None, params=None,
-                 mesh=None) -> APIServer:
+                 mesh=None, leader=None) -> APIServer:
     tokenizer = load_tokenizer(tokenizer_path)
     engine = AsyncLLMEngine(config, params=params,
-                            eos_token_id=tokenizer.eos_token_id, mesh=mesh)
+                            eos_token_id=tokenizer.eos_token_id, mesh=mesh,
+                            leader=leader)
     return APIServer(engine, tokenizer, model_name or config.model.name)
 
 
@@ -369,7 +370,17 @@ def main(argv: Optional[list[str]] = None) -> None:
                    "coordinator from KGCT_COORDINATOR, see parallel/mesh.py)")
     args = p.parse_args(argv)
 
+    follower = None
     if args.distributed:
+        # Followers (rank > 0) must bind their directive listener BEFORE
+        # jax.distributed blocks on the process group, so the leader's lazy
+        # connect always finds it.
+        import os
+
+        from .multihost import CONTROL_PORT, DirectiveFollower
+        if int(os.environ.get("KGCT_PROCESS_ID", "0")) > 0:
+            follower = DirectiveFollower(
+                port=int(os.environ.get("KGCT_CONTROL_PORT", CONTROL_PORT)))
         initialize_distributed()
     model_cfg = get_model_config(args.model)
     if args.dtype:
@@ -404,8 +415,26 @@ def main(argv: Optional[list[str]] = None) -> None:
     if args.weights:
         from ..engine.weights import load_weights
         params = load_weights(args.weights, config.model)
+    if follower is not None:
+        # Rank > 0 of a multi-process mesh: no HTTP API — build the same
+        # engine and serve step directives from rank 0 (SPMD lockstep; see
+        # serving/multihost.py). A minimal /health endpoint keeps the
+        # StatefulSet's shared httpGet probes satisfied.
+        from ..engine import LLMEngine
+        from .multihost import serve_follower_health
+        serve_follower_health(args.port)
+        tokenizer = load_tokenizer(args.tokenizer)
+        engine = LLMEngine(config, params=params,
+                           eos_token_id=tokenizer.eos_token_id, mesh=mesh)
+        follower.run(engine)
+        return
+    leader = None
+    import jax
+    if jax.process_count() > 1:
+        from .multihost import DirectiveLeader, follower_addrs_from_env
+        leader = DirectiveLeader(follower_addrs_from_env())
     server = build_server(config, args.tokenizer, args.model, params=params,
-                          mesh=mesh)
+                          mesh=mesh, leader=leader)
     web.run_app(server.build_app(), host=args.host, port=args.port)
 
 

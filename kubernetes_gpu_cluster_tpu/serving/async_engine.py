@@ -39,9 +39,15 @@ class StreamChunk:
 
 class AsyncLLMEngine:
     def __init__(self, config: EngineConfig, params=None,
-                 eos_token_id: Optional[int] = None, mesh=None):
+                 eos_token_id: Optional[int] = None, mesh=None,
+                 leader=None):
+        """``leader``: serving.multihost.DirectiveLeader when this process
+        is rank 0 of a multi-process mesh — every worker-loop iteration's
+        (adds, aborts) are broadcast to follower ranks BEFORE the local
+        apply+step so all engines schedule in SPMD lockstep."""
         self.engine = LLMEngine(config, params=params,
                                 eos_token_id=eos_token_id, mesh=mesh)
+        self.leader = leader
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._queues: dict[str, asyncio.Queue] = {}
         self._inbox: list = []            # (request_id, token_ids, params)
@@ -63,6 +69,15 @@ class AsyncLLMEngine:
             self._shutdown = True
             self._cv.notify()
         self._thread.join(timeout=30)
+        if self.leader is not None:
+            if self._thread.is_alive():
+                # A wedged worker may still write the directive sockets;
+                # closing now would interleave frames and corrupt the
+                # follower's NDJSON stream. Leave the sockets to the OS.
+                logger.warning("worker thread still alive after join "
+                               "timeout; skipping leader close")
+            else:
+                self.leader.close()
 
     # -- request API ---------------------------------------------------------
 
@@ -110,6 +125,21 @@ class AsyncLLMEngine:
             # and the request would then run orphaned to completion.
             aborted = set(aborts)
             inbox = [item for item in inbox if item[0] not in aborted]
+            if self.leader is not None:
+                # Replicate this iteration's events to follower ranks BEFORE
+                # stepping: their engines apply the same events and step
+                # once, keeping the SPMD collectives in lockstep. A broadcast
+                # failure means the process group is broken (a dead follower
+                # hangs the collectives anyway): fail every waiter loudly
+                # instead of dying silently with requests parked forever.
+                try:
+                    self.leader.broadcast(inbox, aborts)
+                except Exception as e:
+                    logger.exception("directive broadcast failed; "
+                                     "failing all requests")
+                    for rid in list(self._queues):
+                        self._post_exc(rid, e)
+                    return
             for rid in aborts:
                 self.engine.abort_request(rid)
                 self._post(StreamChunk(rid, [], [], True, "abort"))

@@ -1,0 +1,204 @@
+"""Multihost SPMD serving: step-directive replication from rank 0.
+
+Under a mesh that spans processes (the rendered StatefulSet: one engine pod
+per host, jax.distributed over ICI/DCN), every rank must run the SAME
+engine-step sequence — the jitted step enters collectives, and a rank that
+steps alone hangs the process group. But only rank 0 receives client
+traffic (the Service pins to pod-index 0, deploy/render.py). The reference
+solved this with Ray: the vLLM driver shipped work to workers
+(old_README.md:1615-1625). TPU-native replacement:
+
+- The engine's host-side scheduler is DETERMINISTIC given the sequence of
+  (admissions, aborts) applied at each step boundary, so lockstep needs
+  only that event stream — not tensors, not tokens.
+- Rank 0 (leader) broadcasts one DIRECTIVE per worker-loop iteration —
+  ``{"adds": [(rid, token_ids, sampling_params)], "aborts": [rid]}`` as one
+  NDJSON line over a persistent TCP connection to every follower — BEFORE
+  taking its own step, then steps; device collectives do the actual
+  synchronization (a lagging follower simply makes the leader's collective
+  wait).
+- Followers (rank > 0) run no HTTP server: they accept the leader's
+  connection, and for each directive apply the events and take exactly one
+  engine step. Same config + same seed => identical scheduling, identical
+  step programs, lockstep collectives.
+
+Failure model: a dead follower breaks the jax.distributed process group
+anyway (collectives hang), so directive-connection errors are fatal — the
+StatefulSet restarts the group, matching the reference's reset-first
+recovery story (SURVEY §5.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import time
+from typing import Optional
+
+from ..engine import LLMEngine, SamplingParams
+from ..utils import get_logger
+
+logger = get_logger("serving.multihost")
+
+# Directive channel port (the jax.distributed coordinator uses 8476; the
+# deploy renderer exposes both on the headless Service).
+CONTROL_PORT = 8477
+
+
+def _encode(adds, aborts, stop=False) -> bytes:
+    payload = {
+        "adds": [(rid, ids, dataclasses.asdict(params))
+                 for rid, ids, params in adds],
+        "aborts": list(aborts),
+    }
+    if stop:
+        payload["stop"] = True
+    return (json.dumps(payload) + "\n").encode()
+
+
+class DirectiveLeader:
+    """Rank 0's side: persistent connections to every follower, one
+    broadcast per engine-loop iteration. Connections are made lazily with
+    retries — followers bind their listener during process startup, which
+    may complete after the leader's first request arrives."""
+
+    def __init__(self, addrs: list[str], connect_timeout_s: float = 60.0):
+        self.addrs = addrs
+        self.timeout = connect_timeout_s
+        self._socks: Optional[list[socket.socket]] = None
+
+    def _connect(self) -> list[socket.socket]:
+        socks = []
+        for addr in self.addrs:
+            host, _, port = addr.rpartition(":")
+            deadline = time.monotonic() + self.timeout
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)), timeout=5)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    socks.append(s)
+                    logger.info("directive channel up: %s", addr)
+                    break
+                except OSError as e:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"follower {addr} unreachable: {e}") from e
+                    time.sleep(0.5)
+        return socks
+
+    def broadcast(self, adds, aborts) -> None:
+        if self._socks is None:
+            self._socks = self._connect()
+        line = _encode(adds, aborts)
+        for s in self._socks:
+            s.sendall(line)
+
+    def close(self) -> None:
+        if self._socks is None:
+            return
+        for s in self._socks:
+            try:
+                s.sendall(_encode([], [], stop=True))
+                s.close()
+            except OSError:
+                pass
+        self._socks = None
+
+
+class DirectiveFollower:
+    """Rank > 0's side: apply each directive and take exactly one step when
+    the leader does. ``bind()`` early (before jax.distributed blocks on the
+    process group) so the leader's lazy connect finds the listener."""
+
+    def __init__(self, port: int = CONTROL_PORT, host: str = "0.0.0.0"):
+        self._srv = socket.create_server((host, port))
+
+    @property
+    def port(self) -> int:
+        return self._srv.getsockname()[1]
+
+    def run(self, engine: LLMEngine) -> None:
+        conn, peer = self._srv.accept()
+        logger.info("leader connected from %s", peer)
+        buf = b""
+        with conn:
+            while True:
+                while b"\n" not in buf:
+                    data = conn.recv(1 << 16)
+                    if not data:
+                        logger.warning("leader connection closed; exiting")
+                        return
+                    buf += data
+                line, _, buf = buf.partition(b"\n")
+                d = json.loads(line)
+                if d.get("stop"):
+                    logger.info("stop directive; follower exiting")
+                    return
+                for rid in d["aborts"]:
+                    engine.abort_request(rid)
+                for rid, ids, params in d["adds"]:
+                    try:
+                        engine.add_request(rid, ids,
+                                           SamplingParams(**params))
+                    except ValueError as e:
+                        # The leader rejected the same request the same way
+                        # (identical config) and did not schedule it.
+                        logger.info("request %s rejected in lockstep: %s",
+                                    rid, e)
+                # Mirror the leader loop exactly: one step iff there is work.
+                if engine.has_unfinished_requests():
+                    engine.step()
+
+
+def serve_follower_health(port: int, host: str = "0.0.0.0") -> None:
+    """Minimal /health endpoint on the engine port for rank > 0 pods: the
+    StatefulSet's pod template (shared by all ranks) carries httpGet
+    readiness/liveness probes, and a follower with no listener would be
+    killed by kubelet ~3 min after start, crash-looping the whole process
+    group. Runs on a daemon thread; everything but /health is 404."""
+    import http.server
+    import threading
+
+    class Health(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib naming)
+            ok = self.path == "/health"
+            self.send_response(200 if ok else 404)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(b'{"status": "follower"}' if ok else b"{}")
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = http.server.ThreadingHTTPServer((host, port), Health)
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name="kgct-follower-health").start()
+
+
+def follower_addrs_from_env() -> list[str]:
+    """The follower directive endpoints for rank 0.
+
+    KGCT_FOLLOWER_ADDRS (comma-separated host:port) when set — tests and
+    custom topologies; otherwise derived from the StatefulSet DNS pattern in
+    KGCT_COORDINATOR (…-0.<svc>:<port> -> …-{k}.<svc>:CONTROL_PORT) for
+    k in 1..KGCT_NUM_PROCESSES-1, matching deploy/render.py's layout."""
+    import os
+
+    explicit = os.environ.get("KGCT_FOLLOWER_ADDRS")
+    if explicit:
+        return [a for a in explicit.split(",") if a]
+    coord = os.environ.get("KGCT_COORDINATOR", "")
+    n = int(os.environ.get("KGCT_NUM_PROCESSES", "1"))
+    if n <= 1:
+        return []
+    if "-0." not in coord:
+        # Broadcasting to nobody would hang the whole group silently at the
+        # first collective — refuse the misconfiguration instead.
+        raise RuntimeError(
+            f"cannot derive follower addresses: KGCT_COORDINATOR={coord!r} "
+            "does not follow the StatefulSet '<name>-0.<svc>:<port>' "
+            "pattern; set KGCT_FOLLOWER_ADDRS explicitly")
+    host = coord.rpartition(":")[0]
+    return [f"{host.replace('-0.', f'-{k}.', 1)}:{CONTROL_PORT}"
+            for k in range(1, n)]

@@ -218,3 +218,152 @@ def test_two_process_full_engine(tmp_path):
         got = json.loads(line[len(marker):])
         assert got == expected, (
             f"rank {rank} tokens diverged:\n{got}\nvs single-process:\n{expected}")
+
+
+SERVING_LEADER = r"""
+import asyncio, json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["KGCT_REPO"])
+from kubernetes_gpu_cluster_tpu.parallel import initialize_distributed, make_mesh
+
+initialize_distributed()
+from kubernetes_gpu_cluster_tpu.config import (CacheConfig, EngineConfig,
+                                               SchedulerConfig,
+                                               get_model_config)
+from kubernetes_gpu_cluster_tpu.engine import SamplingParams
+from kubernetes_gpu_cluster_tpu.serving.async_engine import AsyncLLMEngine
+from kubernetes_gpu_cluster_tpu.serving.multihost import (
+    DirectiveLeader, follower_addrs_from_env)
+
+cfg = EngineConfig(
+    model=get_model_config("debug-tiny"),
+    cache=CacheConfig(page_size=16, num_pages=64),
+    scheduler=SchedulerConfig(max_num_seqs=4, max_prefill_tokens=128,
+                              decode_buckets=(1, 2, 4), prefill_buckets=(64, 128)))
+eng = AsyncLLMEngine(cfg, mesh=make_mesh(tp=2),
+                     leader=DirectiveLeader(follower_addrs_from_env()))
+
+async def main():
+    eng.start(asyncio.get_running_loop())
+    prompts = json.loads(os.environ["KGCT_TEST_PROMPTS"])
+    async def run_one(i, p):
+        toks = []
+        async for chunk in eng.generate(f"r{i}", list(p),
+                                        SamplingParams(temperature=0.0,
+                                                       max_tokens=8)):
+            toks = chunk.output_token_ids
+        return toks
+    # Submit the second request mid-flight to exercise a non-trivial
+    # directive stream (admissions at different step boundaries).
+    t0 = asyncio.create_task(run_one(0, prompts[0]))
+    await asyncio.sleep(0.2)
+    t1 = asyncio.create_task(run_one(1, prompts[1]))
+    out = [await t0, await t1]
+    print("LEADER-TOKENS:" + json.dumps(out))
+
+asyncio.run(main())
+eng.shutdown()
+"""
+
+SERVING_FOLLOWER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["KGCT_REPO"])
+from kubernetes_gpu_cluster_tpu.serving.multihost import DirectiveFollower
+
+# Bind the directive listener BEFORE jax.distributed blocks on the group.
+follower = DirectiveFollower(port=int(os.environ["KGCT_CONTROL_PORT"]))
+from kubernetes_gpu_cluster_tpu.parallel import initialize_distributed, make_mesh
+initialize_distributed()
+from kubernetes_gpu_cluster_tpu.config import (CacheConfig, EngineConfig,
+                                               SchedulerConfig,
+                                               get_model_config)
+from kubernetes_gpu_cluster_tpu.engine import LLMEngine
+
+cfg = EngineConfig(
+    model=get_model_config("debug-tiny"),
+    cache=CacheConfig(page_size=16, num_pages=64),
+    scheduler=SchedulerConfig(max_num_seqs=4, max_prefill_tokens=128,
+                              decode_buckets=(1, 2, 4), prefill_buckets=(64, 128)))
+eng = LLMEngine(cfg, mesh=make_mesh(tp=2))
+follower.run(eng)
+print("FOLLOWER-DONE")
+"""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="localhost gloo test")
+def test_two_process_serving_leader_follower(tmp_path):
+    """The PRODUCTION multihost serving topology: only rank 0 is driven (the
+    AsyncLLMEngine front door, as behind the HTTP API), rank 1 follows the
+    step-directive stream (serving/multihost.py) — and the pair must produce
+    exactly the single-process engine's greedy tokens. This is what the
+    rendered StatefulSet runs; the reference needed Ray for this role."""
+    import json
+
+    prompts = [[1, 5, 9, 2], [3, 3, 7]]
+
+    from kubernetes_gpu_cluster_tpu.config import (CacheConfig, EngineConfig,
+                                                   SchedulerConfig,
+                                                   get_model_config)
+    from kubernetes_gpu_cluster_tpu.engine import LLMEngine, SamplingParams
+    cfg = EngineConfig(
+        model=get_model_config("debug-tiny"),
+        cache=CacheConfig(page_size=16, num_pages=64),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_prefill_tokens=128,
+                                  decode_buckets=(1, 2, 4),
+                                  prefill_buckets=(64, 128)))
+    expected = [o.output_token_ids for o in LLMEngine(cfg).generate(
+        prompts, SamplingParams(temperature=0.0, max_tokens=8))]
+
+    ports = []
+    for _ in range(2):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+    coord_port, ctrl_port = ports
+
+    scripts = {0: tmp_path / "leader.py", 1: tmp_path / "follower.py"}
+    scripts[0].write_text(SERVING_LEADER)
+    scripts[1].write_text(SERVING_FOLLOWER)
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+
+    procs = []
+    for rank in (0, 1):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "KGCT_REPO": repo,
+            "KGCT_COORDINATOR": f"127.0.0.1:{coord_port}",
+            "KGCT_NUM_PROCESSES": "2",
+            "KGCT_PROCESS_ID": str(rank),
+            "KGCT_CONTROL_PORT": str(ctrl_port),
+            "KGCT_FOLLOWER_ADDRS": f"127.0.0.1:{ctrl_port}",
+            "JAX_NUM_CPU_DEVICES": "1",
+            "TPU_SKIP_MDS_QUERY": "1",
+            "KGCT_TEST_PROMPTS": json.dumps(prompts),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(scripts[rank])], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    rc0, out0, err0 = outs[0]
+    rc1, out1, err1 = outs[1]
+    assert rc0 == 0, f"leader failed:\n{err0[-3000:]}"
+    assert rc1 == 0, f"follower failed:\n{err1[-3000:]}"
+    assert "FOLLOWER-DONE" in out1, (out1, err1[-800:])
+    line = next(l for l in out0.splitlines() if l.startswith("LEADER-TOKENS:"))
+    got = json.loads(line[len("LEADER-TOKENS:"):])
+    assert got == expected, f"{got}\nvs single-process:\n{expected}"
