@@ -184,7 +184,7 @@ class LLMEngine:
         rare chunked-prefill fast path, not the 1.7-1.9x decode speedup."""
         self.use_pallas_hist = False
         if use_pallas is not None:
-            self.use_pallas_hist = use_pallas and self.mesh is None
+            self.use_pallas_hist = use_pallas and self._hist_kernel_eligible()
             return use_pallas
         if jax.default_backend() != "tpu":
             return False
@@ -208,9 +208,18 @@ class LLMEngine:
         # the PER-SHARD head geometry each device will actually build.
         if not self._probe_pallas_compile(tp):
             return False
-        if self.mesh is None:
-            self.use_pallas_hist = self._probe_hist_compile()
+        if self._hist_kernel_eligible():
+            self.use_pallas_hist = self._probe_hist_compile(tp)
         return True
+
+    def _hist_kernel_eligible(self) -> bool:
+        """Where the Pallas history-prefill kernel can serve: meshless
+        engines call it directly; GSPMD tp meshes route it through the tp
+        shard_map wrapper. Under pp the pool's layer axis is pp-sharded
+        (outside the wrapper's specs) and under sp the tp-only wrapper
+        would replicate the whole chunk's history attention across the sp
+        group — both keep the XLA path."""
+        return self.pp_size == 1 and self.sp_size == 1
 
     def _probe_shapes(self, tp: int):
         """Tiny probe inputs at the per-shard head geometry. pps >= the
@@ -276,15 +285,15 @@ class LLMEngine:
                 return False
         return True
 
-    def _probe_hist_compile(self) -> bool:
-        """The history-prefill kernel serves only the meshless path (the
-        dispatcher keeps XLA under meshes) and compiles lazily at the first
-        long prompt — probe it at init so a Mosaic failure surfaces here and
-        disables ONLY the chunked-prefill fast path (the XLA fallback is
-        correct, and decode keeps its kernels)."""
+    def _probe_hist_compile(self, tp: int = 1) -> bool:
+        """The history-prefill kernel compiles lazily at the first long
+        prompt — probe it at init (per-shard geometry under a tp mesh) so a
+        Mosaic failure surfaces here and disables ONLY the chunked-prefill
+        fast path (the XLA fallback is correct, and decode keeps its
+        kernels)."""
         from ..ops.pallas.flash_prefill_hist import flash_prefill_history
 
-        s = self._probe_shapes(tp=1)
+        s = self._probe_shapes(tp)
         scale = s["scale"]
         try:
             jax.jit(lambda *a: flash_prefill_history(
@@ -387,11 +396,16 @@ class LLMEngine:
         history (models.forward_prefill_hist). Extra inputs vs prefill:
         page_table [1, pages_bucket] and hist_len scalar. Compiled lazily —
         engines that never see a long prompt never pay for it. Gated by its
-        own per-kernel flag (use_pallas_hist: meshless engines whose hist
-        probe compiled); under a mesh the dispatcher keeps the XLA path
-        (pool lane sharding; see ops.attention.prefill_history_attention)."""
+        own per-kernel flag (use_pallas_hist); GSPMD meshes route the kernel
+        through the tp shard_map wrapper
+        (ops.attention.prefill_history_attention_tp), pp meshes keep XLA
+        (the pool's layer axis is pp-sharded)."""
         cfg = self.model_config
         use_pallas = self.use_pallas_hist
+        # use_pallas_hist already encodes kernel eligibility (pp/sp
+        # exclusions, probe result); the helper adds the mesh/pp gating the
+        # other builders share.
+        attn_mesh = self._gspmd_attn_mesh() if use_pallas else None
 
         def prefill_hist_step(params, kv: KVCache, int_t, int_b, float_b,
                               page_table, hist_len, key):
@@ -400,7 +414,8 @@ class LLMEngine:
                                logits_indices=int_b[:, 0])
             hidden, kv = model_lib.forward_prefill_hist(
                 params, cfg, int_t[0], meta, kv, page_table[0], hist_len,
-                use_pallas=use_pallas)
+                use_pallas=use_pallas and attn_mesh is None,
+                attn_mesh=attn_mesh)
             logits = model_lib.compute_logits(params, cfg, hidden)
             next_tokens = sample_tokens(logits, key, float_b[:, 0],
                                         int_b[:, 1], float_b[:, 1])

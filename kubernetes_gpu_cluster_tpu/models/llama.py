@@ -36,6 +36,7 @@ from ..ops.attention import (
     ragged_prefill_attention,
     ragged_prefill_attention_tp,
     prefill_history_attention,
+    prefill_history_attention_tp,
     paged_decode_attention,
     paged_decode_attention_tp,
 )
@@ -389,14 +390,19 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
 def forward_prefill_hist(params: Params, cfg: ModelConfig, tokens: jax.Array,
                          meta: PrefillMeta, kv: KVCache,
                          page_table: jax.Array, hist_len: jax.Array,
-                         use_pallas=None):
+                         use_pallas=None, attn_mesh=None):
     """Chunked prefill: one sequence's chunk attending to its pool history +
     itself causally (ops.attention.prefill_history_attention). Returns
-    (normed_selected [1, d], new_kv)."""
+    (normed_selected [1, d], new_kv). ``attn_mesh``: under a GSPMD mesh, run
+    the Pallas history kernel per-shard via shard_map over the tp axis."""
     scale = cfg.head_dim ** -0.5
     h = params["embed"][tokens]
 
     def attn_fn(lp, q, k, v, layer_idx):
+        if attn_mesh is not None:
+            return prefill_history_attention_tp(
+                attn_mesh, q, k, v, meta.seg_ids, meta.positions, kv.k, kv.v,
+                page_table, hist_len, scale, layer=layer_idx)
         return prefill_history_attention(
             q, k, v, meta.seg_ids, meta.positions, kv.k, kv.v,
             page_table, hist_len, scale, layer=layer_idx,

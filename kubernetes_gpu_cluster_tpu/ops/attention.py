@@ -257,9 +257,9 @@ def prefill_history_attention(q, k, v, seg_ids, positions, k_pool, v_pool,
                               use_pallas=None, strict=False):
     """Chunked-prefill dispatcher: Pallas flash kernel on TPU (streams only
     the valid history pages), XLA gather fallback elsewhere. Single-device /
-    shard_map-manual paths only — under a GSPMD mesh callers keep the XLA
-    implementation (the pool's lane sharding would need a tp wrapper; chunked
-    prefill is rare enough that the mesh path stays on the fallback)."""
+    shard_map-manual paths only — GSPMD tp meshes use
+    :func:`prefill_history_attention_tp`; pp meshes keep the XLA fallback
+    (the pool's layer axis is pp-sharded, outside the tp wrapper's specs)."""
     if use_pallas is None:
         use_pallas = _on_tpu()
     if use_pallas:
@@ -337,6 +337,35 @@ def paged_decode_attention_tp(mesh, q, k_cache_l, v_cache_l, page_tables,
     def body(q, kk, vv, tables, ctx, kc, vc, lyr=None):
         return pallas_paged_decode(q, kk, vv, tables, ctx, kc, vc, scale,
                                    layer=lyr, interpret=interpret)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                         out_specs=head_spec, check_vma=False)(*args)
+
+
+def prefill_history_attention_tp(mesh, q, k, v, seg_ids, positions, k_pool,
+                                 v_pool, page_table, hist_len, scale, *,
+                                 layer=None, interpret=False):
+    """shard_map-wrapped flash_prefill_history over ``mesh``'s tp axis: q/k/v
+    split on heads, the pool on its flattened kv-head lane dim, page table
+    and history length replicated — chunked prefill keeps the Pallas fast
+    path under GSPMD tp serving."""
+    from jax.sharding import PartitionSpec as P
+
+    from .pallas.flash_prefill_hist import flash_prefill_history
+
+    pool_spec = P(*([None] * (k_pool.ndim - 1)), "tp")
+    head_spec = P(None, "tp", None)
+    in_specs = [head_spec, head_spec, head_spec, P(), P(),
+                pool_spec, pool_spec, P(), P()]
+    args = [q, k, v, seg_ids, positions, k_pool, v_pool,
+            page_table, jnp.asarray(hist_len, jnp.int32)]
+    if layer is not None:
+        in_specs.append(P())
+        args.append(jnp.asarray(layer, jnp.int32).reshape(()))
+
+    def body(q, k, v, seg, pos, kp, vp, pt, hl, lyr=None):
+        return flash_prefill_history(q, k, v, seg, pos, kp, vp, pt, hl,
+                                     scale, layer=lyr, interpret=interpret)
 
     return jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
                          out_specs=head_spec, check_vma=False)(*args)

@@ -293,3 +293,37 @@ def test_flash_prefill_partial_final_block():
     assert np.isfinite(np.asarray(got)[mask]).all()
     np.testing.assert_allclose(np.asarray(got)[mask], np.asarray(ref)[mask],
                                rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_history_tp_matches_oracle():
+    """The hist-kernel tp wrapper (chunked prefill under GSPMD meshes):
+    interpret parity on the CPU tp=2 mesh vs the XLA oracle."""
+    from kubernetes_gpu_cluster_tpu.ops.attention import (
+        prefill_history_attention_tp, prefill_history_attention_xla)
+    from kubernetes_gpu_cluster_tpu.parallel import make_mesh
+
+    mesh = make_mesh(tp=2, dp=4)
+    T, nh, nkv, hd, ps, pps, L = 16, 4, 2, 32, 8, 4, 2
+    hist_len = 13
+    rng = np.random.default_rng(12)
+    q = jnp.asarray(rng.standard_normal((T, nh, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((T, nkv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((T, nkv, hd)), jnp.float32)
+    seg = jnp.asarray(np.where(np.arange(T) < T - 3, 0, -1), jnp.int32)
+    pos = jnp.asarray(np.where(np.arange(T) < T - 3,
+                               hist_len + np.arange(T), 0), jnp.int32)
+    pk = jnp.asarray(rng.standard_normal((L, 1 + pps, ps, nkv * hd)), jnp.float32)
+    pv = jnp.asarray(rng.standard_normal((L, 1 + pps, ps, nkv * hd)), jnp.float32)
+    pt = jnp.asarray(1 + np.arange(pps), jnp.int32)
+    hl = jnp.asarray(hist_len, jnp.int32)
+    for layer in range(L):
+        ref = prefill_history_attention_xla(q, k, v, seg, pos, pk, pv, pt,
+                                            hl, 0.125, layer=jnp.asarray(layer))
+        got = prefill_history_attention_tp(mesh, q, k, v, seg, pos, pk, pv,
+                                           pt, hl, 0.125,
+                                           layer=jnp.asarray(layer),
+                                           interpret=True)
+        mask = np.asarray(seg) >= 0
+        np.testing.assert_allclose(np.asarray(got)[mask],
+                                   np.asarray(ref)[mask],
+                                   rtol=2e-5, atol=2e-5)
