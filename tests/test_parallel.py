@@ -273,3 +273,48 @@ def test_parallel_config_sp_axis():
     mesh = mesh_from_config(cfg)
     assert mesh.shape["sp"] == 8
     assert mesh_from_config(ParallelConfig()) is None
+
+
+def test_llama70b_tp8_decode_traces():
+    """North-star config 5 (llama-3-70b, tp=8): the sharded decode step must
+    TRACE cleanly at full 70B geometry — params as ShapeDtypeStructs, so no
+    weights materialize — proving shapes, sharding specs, and kernel lane
+    math are sound at the scale the driver cannot run."""
+    import jax
+
+    from kubernetes_gpu_cluster_tpu.config import CacheConfig, get_model_config
+    from kubernetes_gpu_cluster_tpu.engine.kv_cache import KVCache
+    from kubernetes_gpu_cluster_tpu.parallel import make_mesh
+    from kubernetes_gpu_cluster_tpu.parallel.sharding import (
+        kv_cache_sharding, param_shardings)
+
+    cfg = get_model_config("llama-3-70b")
+    mesh = make_mesh(tp=8)
+    shardings = param_shardings(mesh, cfg)   # validates divisibility at tp=8
+
+    def abstract_params():
+        return model_lib.init_params(cfg, jax.random.key(0))
+
+    p_shapes = jax.eval_shape(abstract_params)
+    # Structures must match so device_put(params, shardings) would succeed.
+    jax.tree.map(lambda a, s: None, p_shapes, shardings)
+    assert kv_cache_sharding(mesh, cfg) is not None
+
+    B, pps, ps = 4, 4, 16
+    kv_shape = (cfg.num_layers, 1 + B * pps, ps,
+                cfg.num_kv_heads * cfg.head_dim)
+    kv = KVCache(k=jax.ShapeDtypeStruct(kv_shape, cfg.jnp_dtype),
+                 v=jax.ShapeDtypeStruct(kv_shape, cfg.jnp_dtype))
+    meta = model_lib.DecodeMeta(
+        positions=jax.ShapeDtypeStruct((B,), jnp.int32),
+        slot_mapping=jax.ShapeDtypeStruct((B,), jnp.int32),
+        page_tables=jax.ShapeDtypeStruct((B, pps), jnp.int32),
+        context_lens=jax.ShapeDtypeStruct((B,), jnp.int32))
+    tokens = jax.ShapeDtypeStruct((B,), jnp.int32)
+
+    def step(params, kv, tokens, meta):
+        hidden, kv, _ = model_lib.forward_decode(params, cfg, tokens, meta, kv)
+        return model_lib.compute_logits(params, cfg, hidden), kv
+
+    out_shape = jax.eval_shape(step, p_shapes, kv, tokens, meta)
+    assert out_shape[0].shape == (B, cfg.vocab_size)
