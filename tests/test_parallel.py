@@ -275,27 +275,28 @@ def test_parallel_config_sp_axis():
     assert mesh_from_config(ParallelConfig()) is None
 
 
-def test_llama70b_tp8_decode_traces():
-    """North-star config 5 (llama-3-70b, tp=8): the sharded decode step must
-    TRACE cleanly at full 70B geometry — params as ShapeDtypeStructs, so no
-    weights materialize — proving shapes, sharding specs, and kernel lane
-    math are sound at the scale the driver cannot run."""
+@pytest.mark.parametrize("model,axes", [
+    ("llama-3-8b", {"tp": 8}),            # BASELINE config 3: 8B TP=8 over ICI
+    ("mixtral-8x7b", {"tp": 2, "ep": 4}),  # config 4: MoE expert-parallel
+    ("llama-3-70b", {"tp": 8}),           # config 5 (TP part): 70B one slice
+])
+def test_north_star_configs_trace(model, axes):
+    """BASELINE north-star configs at FULL model geometry: the sharded decode
+    step must TRACE cleanly — params as ShapeDtypeStructs, so no weights
+    materialize — proving shapes, sharding specs, and kernel lane math are
+    sound at scales the single-chip driver cannot execute."""
     import jax
 
-    from kubernetes_gpu_cluster_tpu.config import CacheConfig, get_model_config
+    from kubernetes_gpu_cluster_tpu.config import get_model_config
     from kubernetes_gpu_cluster_tpu.engine.kv_cache import KVCache
     from kubernetes_gpu_cluster_tpu.parallel import make_mesh
     from kubernetes_gpu_cluster_tpu.parallel.sharding import (
         kv_cache_sharding, param_shardings)
 
-    cfg = get_model_config("llama-3-70b")
-    mesh = make_mesh(tp=8)
-    shardings = param_shardings(mesh, cfg)   # validates divisibility at tp=8
-
-    def abstract_params():
-        return model_lib.init_params(cfg, jax.random.key(0))
-
-    p_shapes = jax.eval_shape(abstract_params)
+    cfg = get_model_config(model)
+    mesh = make_mesh(**axes)
+    shardings = param_shardings(mesh, cfg)   # validates divisibility
+    p_shapes = jax.eval_shape(lambda: model_lib.init_params(cfg, jax.random.key(0)))
     # Structures must match so device_put(params, shardings) would succeed.
     jax.tree.map(lambda a, s: None, p_shapes, shardings)
     assert kv_cache_sharding(mesh, cfg) is not None
@@ -318,3 +319,36 @@ def test_llama70b_tp8_decode_traces():
 
     out_shape = jax.eval_shape(step, p_shapes, kv, tokens, meta)
     assert out_shape[0].shape == (B, cfg.vocab_size)
+
+
+def test_north_star_70b_tp_pp_traces():
+    """Config 5's TP+PP form: the circular-pipeline decode forward traces at
+    full 70B geometry over pp=2 x tp=4 (80 layers -> 40-layer stages)."""
+    import jax
+
+    from kubernetes_gpu_cluster_tpu.config import get_model_config
+    from kubernetes_gpu_cluster_tpu.engine.kv_cache import KVCache
+    from kubernetes_gpu_cluster_tpu.parallel import make_mesh
+    from kubernetes_gpu_cluster_tpu.parallel.pp import (build_pp_forward,
+                                                        validate_pp_mesh)
+
+    cfg = get_model_config("llama-3-70b")
+    mesh = make_mesh(pp=2, tp=4)
+    validate_pp_mesh(mesh, cfg)
+    p_shapes = jax.eval_shape(lambda: model_lib.init_params(cfg, jax.random.key(0)))
+
+    M, B, pps, ps = 2, 2, 4, 16
+    kv_shape = (cfg.num_layers, 1 + M * B * pps, ps,
+                cfg.num_kv_heads * cfg.head_dim)
+    kv = KVCache(k=jax.ShapeDtypeStruct(kv_shape, cfg.jnp_dtype),
+                 v=jax.ShapeDtypeStruct(kv_shape, cfg.jnp_dtype))
+    meta = model_lib.DecodeMeta(
+        positions=jax.ShapeDtypeStruct((M, B), jnp.int32),
+        slot_mapping=jax.ShapeDtypeStruct((M, B), jnp.int32),
+        page_tables=jax.ShapeDtypeStruct((M, B, pps), jnp.int32),
+        context_lens=jax.ShapeDtypeStruct((M, B), jnp.int32))
+    tokens = jax.ShapeDtypeStruct((M, B), jnp.int32)
+
+    fn = build_pp_forward(mesh, cfg, "decode", use_pallas=False)
+    out_shape, kv_shape_out = jax.eval_shape(fn, p_shapes, kv, tokens, meta)
+    assert out_shape.shape == (M, B, cfg.hidden_size)
