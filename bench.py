@@ -55,11 +55,13 @@ PROMPT_LEN = int(os.environ.get("KGCT_BENCH_PROMPT", 128))
 # the bench measures the SHIPPED default config.
 PAGE = (int(os.environ["KGCT_BENCH_PAGE"])
         if os.environ.get("KGCT_BENCH_PAGE") else None)
-# Substeps per XLA program. 32 measures best end-to-end on the tunnel chip
-# (A/B vs 64: larger windows grow per-window device time past what extra
-# host-RT amortization buys back, and push contexts longer for the same
-# token budget).
-DECODE_WINDOW = int(os.environ.get("KGCT_BENCH_WINDOW", 32))
+# Substeps per XLA program. Re-tuned in r4 after the kernel optimizations
+# (global-stream decode prefetch + segment-window prefill) shortened the
+# per-substep device time: at matched token budgets W=48 beat W=32 in every
+# interleaved pair (11.0-11.3k vs 7.4-9.6k tok/s) — the fixed ~110 ms
+# per-window tunnel round trip amortizes worse once substeps got faster.
+# W=64 measured ~W=48. (r3 had found 32 > 64 with the slower kernel.)
+DECODE_WINDOW = int(os.environ.get("KGCT_BENCH_WINDOW", 48))
 # Prefill token budget per step. 4096 (2 steps for the 64x128 batch) is the
 # measured operating point AFTER the segment-aware k-window upgrade to the
 # flash prefill kernel removed the O(T^2) masked-block DMA: p95 TTFT 649 ms
