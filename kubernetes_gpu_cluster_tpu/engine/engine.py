@@ -31,7 +31,7 @@ import numpy as np
 from ..config import EngineConfig
 from ..models import llama as model_lib
 from ..models.llama import DecodeMeta, PrefillMeta
-from ..ops.sampling import sample_tokens
+from ..ops.sampling import sample_tokens, token_logprobs
 from ..utils import cdiv, get_logger
 from .kv_cache import KVCache, allocate_kv_cache, derive_num_pages
 from .sampling_params import SamplingParams
@@ -70,6 +70,8 @@ class RequestOutput:
     finished: bool
     finish_reason: Optional[str] = None
     new_token_ids: Optional[list[int]] = None  # tokens produced this step
+    new_logprobs: Optional[list[float]] = None  # chosen-token logprobs, ditto
+    output_logprobs: Optional[list[float]] = None  # full per-token record
 
 
 class LLMEngine:
@@ -387,7 +389,7 @@ class LLMEngine:
             logits, kv = fwd(params, kv, int_t, int_b[:, 0])
             next_tokens = sample_tokens(logits, key, float_b[:, 0],
                                         int_b[:, 1], float_b[:, 1])
-            return next_tokens, kv
+            return next_tokens, token_logprobs(logits, next_tokens), kv
 
         return self._maybe_jit(prefill_step, donate_argnums=(1,))
 
@@ -419,7 +421,7 @@ class LLMEngine:
             logits = model_lib.compute_logits(params, cfg, hidden)
             next_tokens = sample_tokens(logits, key, float_b[:, 0],
                                         int_b[:, 1], float_b[:, 1])
-            return next_tokens, kv
+            return next_tokens, token_logprobs(logits, next_tokens), kv
 
         return self._maybe_jit(prefill_hist_step, donate_argnums=(1,))
 
@@ -508,11 +510,12 @@ class LLMEngine:
                     next_tokens = sample_tokens(
                         logits, jax.random.fold_in(key, i),
                         temperature, top_k, top_p)
-                return (kv, next_tokens, pos + 1), next_tokens
+                lps = token_logprobs(logits, next_tokens)
+                return (kv, next_tokens, pos + 1), (next_tokens, lps)
 
-            (kv, _, _), toks = jax.lax.scan(
+            (kv, _, _), (toks, lps) = jax.lax.scan(
                 substep, (kv, tokens0, positions0), jnp.arange(W))
-            return toks.T, kv    # [B, W]
+            return toks.T, lps.T, kv    # [B, W] each
 
         return self._maybe_jit(decode_window, donate_argnums=(1,))
 
@@ -591,7 +594,7 @@ class LLMEngine:
                     # Chunked prefill (solo): chunk attends to pool history.
                     self.stats.prefill_tokens += int(
                         np.sum(batch.seg_ids >= 0))
-                    next_tokens, self.kv_cache = self._prefill_hist_fn(
+                    next_tokens, lps, self.kv_cache = self._prefill_hist_fn(
                         self.params, self.kv_cache, int_t, int_b, float_b,
                         jnp.asarray(batch.page_tables),
                         jnp.int32(batch.hist_len), step_key)
@@ -602,11 +605,12 @@ class LLMEngine:
                 else:
                     self.stats.prefill_tokens += sum(
                         s.num_tokens for s in batch.seqs)
-                    next_tokens, self.kv_cache = self._prefill_fn(
+                    next_tokens, lps, self.kv_cache = self._prefill_fn(
                         self.params, self.kv_cache, int_t, int_b, float_b,
                         step_key)
                 return drained + self._process_window(
-                    batch, np.asarray(next_tokens)[:, None], set(), defer=False)
+                    batch, np.asarray(next_tokens)[:, None],
+                    np.asarray(lps)[:, None], set(), defer=False)
             inflight = self._dispatch_window(
                 batch, jnp.asarray(batch.tokens), batch.positions, float_b)
             inflight["drained"] = drained
@@ -616,9 +620,10 @@ class LLMEngine:
             successor = self._advance_window(inflight)
 
         toks = np.asarray(inflight["dev_out"])   # syncs; overlaps successor
+        lps = np.asarray(inflight["dev_lp"])
         self._inflight = successor
         outputs = inflight.pop("drained", []) + self._process_window(
-            inflight["batch"], toks, inflight["zombies"],
+            inflight["batch"], toks, lps, inflight["zombies"],
             defer=successor is not None)
         if successor is not None:
             successor["zombies"].update(
@@ -635,10 +640,10 @@ class LLMEngine:
         self._key, step_key = jax.random.split(self._key)
         fn = (self._decode_fn_greedy if bool(np.all(batch.temperature <= 0))
               else self._decode_fn)
-        dev_out, self.kv_cache = fn(
+        dev_out, dev_lp, self.kv_cache = fn(
             self.params, self.kv_cache, tokens_dev, int_b, float_b, step_key)
-        return {"batch": batch, "dev_out": dev_out, "positions": positions,
-                "float_b": float_b, "zombies": set()}
+        return {"batch": batch, "dev_out": dev_out, "dev_lp": dev_lp,
+                "positions": positions, "float_b": float_b, "zombies": set()}
 
     def _advance_window(self, inflight: dict) -> Optional[dict]:
         """Build + dispatch the speculative successor window: same batch
@@ -668,9 +673,11 @@ class LLMEngine:
                                      new_positions, inflight["float_b"])
 
     def _process_window(self, batch: ScheduledBatch, next_tokens: np.ndarray,
-                        zombies: set, defer: bool) -> list[RequestOutput]:
-        """next_tokens: [B_pad, W]. Append window tokens per sequence until a
-        stop condition fires; tokens generated past the stop are discarded.
+                        logprobs: np.ndarray, zombies: set,
+                        defer: bool) -> list[RequestOutput]:
+        """next_tokens/logprobs: [B_pad, W]. Append window tokens per sequence
+        until a stop condition fires; tokens generated past the stop are
+        discarded.
         ``zombies`` (request ids finished in an earlier chained window) are
         skipped; with ``defer`` the pages of newly finished sequences are held
         until the chain drains (an in-flight window may still write to them).
@@ -680,11 +687,18 @@ class LLMEngine:
             if seq.request_id in zombies:
                 continue
             had_first = seq.first_token_time is not None
+            want_lps = seq.params.logprobs
             new_tokens: list[int] = []
-            for token in next_tokens[s]:
+            new_lps: list[float] = []
+            for token, lp in zip(next_tokens[s], logprobs[s]):
                 token = int(token)
-                seq.append_token(token)
+                # Per-request gating: the device computes logprobs
+                # unconditionally (negligible next to sampling), but the
+                # host records them only for requests that asked.
+                seq.append_token(token, float(lp) if want_lps else None)
                 new_tokens.append(token)
+                if want_lps:
+                    new_lps.append(float(lp))
                 reason = seq.check_stop(self.config.effective_max_len)
                 if reason is not None:
                     if defer:
@@ -707,7 +721,10 @@ class LLMEngine:
                 output_token_ids=list(seq.output_token_ids),
                 finished=seq.is_finished,
                 finish_reason=seq.finish_reason.value if seq.finish_reason else None,
-                new_token_ids=new_tokens))
+                new_token_ids=new_tokens,
+                new_logprobs=new_lps if want_lps else None,
+                output_logprobs=(list(seq.output_logprobs)
+                                 if want_lps else None)))
         return outputs
 
     def _drain_terminally_finished(self) -> list[RequestOutput]:
@@ -724,7 +741,9 @@ class LLMEngine:
                 output_token_ids=list(seq.output_token_ids),
                 finished=True,
                 finish_reason=seq.finish_reason.value if seq.finish_reason else None,
-                new_token_ids=[]))
+                new_token_ids=[],
+                output_logprobs=(list(seq.output_logprobs)
+                                 if seq.params.logprobs else None)))
         self.scheduler.terminally_finished.clear()
         return outs
 

@@ -31,6 +31,7 @@ class Sequence:
         self.request_id = request_id
         self.prompt_token_ids = list(prompt_token_ids)
         self.output_token_ids: list[int] = []
+        self.output_logprobs: list[float] = []
         self.params = params
         self.eos_token_id = eos_token_id
         self.status = SequenceStatus.WAITING
@@ -68,10 +69,13 @@ class Sequence:
     def is_finished(self) -> bool:
         return self.status == SequenceStatus.FINISHED
 
-    def append_token(self, token_id: int) -> None:
+    def append_token(self, token_id: int,
+                     logprob: Optional[float] = None) -> None:
         if self.first_token_time is None:
             self.first_token_time = time.monotonic()
         self.output_token_ids.append(token_id)
+        if logprob is not None:
+            self.output_logprobs.append(logprob)
 
     def check_stop(self, max_model_len: int) -> Optional[FinishReason]:
         """Token-level stop conditions (string-level stops are handled by the

@@ -69,3 +69,17 @@ def sample_tokens(
     sampled_ids = jax.lax.cond(jnp.any(temperature > 0), sampled_path,
                                lambda _: greedy_ids, None)
     return jnp.where(temperature <= 0, greedy_ids, sampled_ids)
+
+
+def token_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Log-probability of each chosen token under the UNFILTERED
+    distribution (vLLM reports pre-truncation logprobs): logits [B, V] f32,
+    tokens [B] int32 -> [B] f32. One max-reduce + one logsumexp next to the
+    sampling sorts — negligible, so the step programs compute it
+    unconditionally; the HOST records it per request only when
+    SamplingParams.logprobs is set (engine._process_window)."""
+    shifted = logits - jnp.max(logits, axis=-1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
+    chosen = jnp.take_along_axis(shifted, tokens[:, None].astype(jnp.int32),
+                                 axis=-1)[:, 0]
+    return chosen - lse

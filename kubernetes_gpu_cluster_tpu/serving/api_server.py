@@ -44,7 +44,21 @@ def _sampling_params(body: dict, eos_token_id: Optional[int]) -> SamplingParams:
         top_k=int(body.get("top_k", 0)),
         stop_token_ids=tuple([eos_token_id] if eos_token_id is not None else [])
         + tuple(body.get("stop_token_ids") or ()),
+        logprobs=bool(body.get("logprobs")),
     )
+
+
+def _logprobs_requested(body: dict):
+    """OpenAI completions ``logprobs``: null/0/false => off; 1/true =>
+    chosen-token logprobs. Alternatives (top-k > 1) are not supported —
+    only the sampled token's logprob leaves the device."""
+    lp = body.get("logprobs")
+    if not lp:
+        return False, None
+    if lp is True or int(lp) == 1:
+        return True, None
+    return False, _error(400, "logprobs > 1 (top alternatives) is not "
+                              "supported; use logprobs: 1")
 
 
 def _stops(body: dict) -> list[str]:
@@ -174,6 +188,12 @@ class APIServer:
 
     async def _run(self, request: web.Request, body: dict, ids: list[int],
                    kind: str) -> web.StreamResponse:
+        want_lps, lp_err = _logprobs_requested(body)
+        if lp_err is not None:
+            return lp_err
+        if want_lps and kind != "completion":
+            return _error(400, "logprobs are supported on /v1/completions "
+                               "only")
         params = _sampling_params(body, self.tokenizer.eos_token_id)
         detok = IncrementalDetokenizer(self.tokenizer, stop=_stops(body))
         rid = self.engine.next_request_id(
@@ -191,7 +211,8 @@ class APIServer:
         complete = False
         if not stream:
             try:
-                text, finish_reason, n_out = await self._collect(gen, detok, rid)
+                (text, finish_reason, n_out, tok_ids,
+                 tok_lps) = await self._collect(gen, detok, rid)
                 complete = True
             except ValueError as e:
                 complete = True      # engine already rejected/finished it
@@ -201,9 +222,15 @@ class APIServer:
                 if not complete:
                     self.engine.abort(rid)
             self.metrics.on_finish(n_out)
-            return web.json_response(_response_body(
+            resp_body = _response_body(
                 kind, rid, created, self.model_name, text, finish_reason,
-                prompt_tokens=len(ids), completion_tokens=n_out))
+                prompt_tokens=len(ids), completion_tokens=n_out)
+            if want_lps and kind == "completion":
+                resp_body["choices"][0]["logprobs"] = {
+                    "tokens": [self.tokenizer.decode([t]) for t in tok_ids],
+                    "token_logprobs": tok_lps,
+                }
+            return web.json_response(resp_body)
 
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
@@ -217,12 +244,27 @@ class APIServer:
                 finished = chunk.finished or detok.stopped
                 if detok.stopped and not chunk.finished:
                     self.engine.abort(rid)
-                if delta or finished:
+                # Emit when there is text, a finish, or logprobs to carry —
+                # the detokenizer may hold text back (partial UTF-8 / stop
+                # candidates) while the chunk's token logprobs still need a
+                # frame (empty-text chunks are valid in OpenAI streams).
+                if delta or finished or (want_lps and chunk.new_token_ids
+                                         and not detok.stopped):
                     reason = ("stop" if detok.stopped
                               else _map_reason(chunk.finish_reason))
-                    await resp.write(_sse(_stream_body(
+                    sb = _stream_body(
                         kind, rid, created, self.model_name, delta,
-                        reason if finished else None)))
+                        reason if finished else None)
+                    if want_lps and not detok.stopped:
+                        # Stop-string chunks are excluded: their trailing
+                        # tokens are not part of the emitted text (see
+                        # _collect).
+                        sb["choices"][0]["logprobs"] = {
+                            "tokens": [self.tokenizer.decode([t])
+                                       for t in chunk.new_token_ids],
+                            "token_logprobs": list(chunk.new_logprobs),
+                        }
+                    await resp.write(_sse(sb))
                 if finished:
                     complete = True
                     break
@@ -241,17 +283,25 @@ class APIServer:
         text = []
         finish_reason = None
         n_out = 0
+        tok_ids: list[int] = []
+        tok_lps: list[float] = []
         async for chunk in gen:
             n_out = len(chunk.output_token_ids)
             text.append(detok.push(chunk.new_token_ids, final=chunk.finished))
             if detok.stopped:
+                # The chunk containing the stop match is excluded from the
+                # logprobs record: its trailing tokens are not represented
+                # in the truncated text (the record may slightly
+                # under-include the final chunk's pre-stop tokens).
                 if not chunk.finished:
                     self.engine.abort(rid)
                 finish_reason = "stop"
                 break
+            tok_ids.extend(chunk.new_token_ids)
+            tok_lps.extend(chunk.new_logprobs or [])
             if chunk.finished:
                 finish_reason = _map_reason(chunk.finish_reason)
-        return "".join(text), finish_reason, n_out
+        return "".join(text), finish_reason, n_out, tok_ids, tok_lps
 
 
 # -- OpenAI wire formats ----------------------------------------------------

@@ -35,6 +35,7 @@ class StreamChunk:
     output_token_ids: list[int]
     finished: bool
     finish_reason: Optional[str]
+    new_logprobs: list[float] = dataclasses.field(default_factory=list)
 
 
 class AsyncLLMEngine:
@@ -175,4 +176,5 @@ def _chunk_of(out: RequestOutput) -> StreamChunk:
         new_token_ids=list(out.new_token_ids or []),
         output_token_ids=list(out.output_token_ids),
         finished=out.finished,
-        finish_reason=out.finish_reason)
+        finish_reason=out.finish_reason,
+        new_logprobs=list(out.new_logprobs or []))

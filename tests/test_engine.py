@@ -149,3 +149,56 @@ class TestDecodeWindowEquivalence:
             eng = LLMEngine(cfg, params=params)
             outs[w] = [o.output_token_ids for o in eng.generate(prompts, sp)]
         assert outs[1] == outs[4]
+
+
+class TestLogprobs:
+    def test_greedy_logprobs_match_forward(self):
+        """The engine's per-token logprob record must match the log-softmax
+        of an independent forward pass for the first sampled token, align
+        1:1 with output tokens, and be non-positive throughout."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubernetes_gpu_cluster_tpu.config import (CacheConfig,
+                                                       EngineConfig,
+                                                       SchedulerConfig,
+                                                       get_model_config)
+        from kubernetes_gpu_cluster_tpu.engine.kv_cache import allocate_kv_cache
+        from kubernetes_gpu_cluster_tpu.models import llama as model_lib
+
+        cfg = EngineConfig(
+            model=get_model_config("debug-tiny"),
+            cache=CacheConfig(page_size=16, num_pages=33),
+            scheduler=SchedulerConfig(max_num_seqs=2, max_prefill_tokens=64,
+                                      decode_buckets=(1, 2),
+                                      prefill_buckets=(64,)))
+        params = model_lib.init_params(cfg.model, jax.random.key(0))
+        eng = LLMEngine(cfg, params=params)
+        prompt = [1, 5, 9, 2]
+        out = eng.generate([prompt], SamplingParams(
+            temperature=0.0, max_tokens=4, logprobs=True))[0]
+        assert len(out.output_logprobs) == len(out.output_token_ids)
+        assert all(lp <= 0.0 for lp in out.output_logprobs)
+
+        # Manual prefill forward -> log-softmax at the sampled token.
+        T = 64
+        toks = np.zeros(T, np.int32)
+        toks[:len(prompt)] = prompt
+        seg = np.where(np.arange(T) < len(prompt), 0, -1).astype(np.int32)
+        pos = np.where(np.arange(T) < len(prompt),
+                       np.arange(T), 0).astype(np.int32)
+        slots = np.where(np.arange(T) < len(prompt),
+                         16 + np.arange(T), np.arange(T) % 16).astype(np.int32)
+        meta = model_lib.PrefillMeta(
+            seg_ids=jnp.asarray(seg), positions=jnp.asarray(pos),
+            slot_mapping=jnp.asarray(slots),
+            logits_indices=jnp.asarray([len(prompt) - 1], jnp.int32))
+        kv = allocate_kv_cache(cfg.model, cfg.cache, 33)
+        hidden, _, _ = model_lib.forward_prefill(params, cfg.model,
+                                                 jnp.asarray(toks), meta, kv)
+        logits = model_lib.compute_logits(params, cfg.model, hidden)[0]
+        assert out.output_token_ids[0] == int(jnp.argmax(logits))
+        ref_lp = float(jax.nn.log_softmax(logits)[out.output_token_ids[0]])
+        np.testing.assert_allclose(out.output_logprobs[0], ref_lp,
+                                   rtol=1e-4, atol=1e-4)

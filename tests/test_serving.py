@@ -296,3 +296,66 @@ class TestRouterFailover:
                 await runner.cleanup()
 
         asyncio.run(scenario())
+
+
+class TestLogprobsAPI:
+    def test_completions_logprobs(self, api_client):
+        """OpenAI completions logprobs parity: logprobs: 1 returns the
+        chosen-token logprobs aligned with tokens; >1 (alternatives) is a
+        clean 400."""
+        loop, client = api_client
+
+        async def go():
+            r = await client.post("/v1/completions", json={
+                "prompt": [1, 5, 9], "max_tokens": 4, "temperature": 0.0,
+                "logprobs": 1})
+            assert r.status == 200
+            body = await r.json()
+            lp = body["choices"][0]["logprobs"]
+            assert len(lp["token_logprobs"]) == len(lp["tokens"]) == 4
+            assert all(isinstance(x, float) and x <= 0.0
+                       for x in lp["token_logprobs"])
+
+            # Determinism: greedy rerun returns identical logprobs.
+            r2 = await client.post("/v1/completions", json={
+                "prompt": [1, 5, 9], "max_tokens": 4, "temperature": 0.0,
+                "logprobs": 1})
+            lp2 = (await r2.json())["choices"][0]["logprobs"]
+            assert lp2["token_logprobs"] == lp["token_logprobs"]
+
+            r3 = await client.post("/v1/completions", json={
+                "prompt": [1, 5, 9], "max_tokens": 2, "logprobs": 5})
+            assert r3.status == 400
+
+            # Off by default: no logprobs object.
+            r4 = await client.post("/v1/completions", json={
+                "prompt": [1, 5, 9], "max_tokens": 2, "temperature": 0.0})
+            assert "logprobs" not in (await r4.json())["choices"][0]
+        loop.run_until_complete(go())
+
+    def test_streaming_logprobs_and_chat_rejection(self, api_client):
+        loop, client = api_client
+
+        async def go():
+            r = await client.post("/v1/completions", json={
+                "prompt": [1, 5, 9], "max_tokens": 4, "temperature": 0.0,
+                "logprobs": 1, "stream": True})
+            assert r.status == 200
+            lps = []
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    ev = json.loads(line[len("data: "):])
+                    lp = ev["choices"][0].get("logprobs")
+                    if lp:
+                        assert len(lp["tokens"]) == len(lp["token_logprobs"])
+                        lps.extend(lp["token_logprobs"])
+                if line == "data: [DONE]":
+                    break
+            assert len(lps) == 4 and all(x <= 0 for x in lps)
+
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 2, "logprobs": 1})
+            assert r.status == 400
+        loop.run_until_complete(go())
