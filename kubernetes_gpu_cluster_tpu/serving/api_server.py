@@ -200,6 +200,19 @@ class APIServer:
             "cmpl" if kind == "completion" else "chatcmpl")
         created = int(time.time())
         stream = bool(body.get("stream"))
+        try:
+            n = 1 if body.get("n") is None else int(body["n"])
+        except (TypeError, ValueError):
+            return _error(400, "n must be an integer")
+        if n < 1:
+            return _error(400, "n must be >= 1")
+        if n > 128:   # OpenAI's cap; bounds queue/memory blast radius
+            return _error(400, "n must be <= 128")
+        if n > 1:
+            if stream:
+                return _error(400, "n > 1 with stream is not supported")
+            return await self._run_n(body, ids, params, kind, rid, created,
+                                     n, want_lps)
         self.metrics.on_request()
 
         # ``complete`` guards the engine-side abort: any early handler exit —
@@ -222,15 +235,11 @@ class APIServer:
                 if not complete:
                     self.engine.abort(rid)
             self.metrics.on_finish(n_out)
-            resp_body = _response_body(
-                kind, rid, created, self.model_name, text, finish_reason,
-                prompt_tokens=len(ids), completion_tokens=n_out)
-            if want_lps and kind == "completion":
-                resp_body["choices"][0]["logprobs"] = {
-                    "tokens": [self.tokenizer.decode([t]) for t in tok_ids],
-                    "token_logprobs": tok_lps,
-                }
-            return web.json_response(resp_body)
+            return web.json_response(_response_envelope(
+                kind, rid, created, self.model_name,
+                [_choice(kind, 0, text, finish_reason, self.tokenizer,
+                         tok_ids, tok_lps, want_lps)],
+                prompt_tokens=len(ids), completion_tokens=n_out))
 
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
@@ -279,6 +288,56 @@ class APIServer:
         await resp.write_eof()
         return resp
 
+    async def _run_n(self, body, ids, params, kind, rid, created, n,
+                     want_lps) -> web.Response:
+        """OpenAI ``n`` > 1: n engine requests for one prompt, gathered
+        concurrently into n choices (with prefix caching enabled the n-1
+        duplicates reuse the prompt's KV pages). Greedy sampling yields n
+        identical choices — same as vLLM; use temperature > 0 for variety."""
+        import asyncio
+
+        self.metrics.on_request()
+
+        async def one(i):
+            sub = f"{rid}-{i}"
+            detok = IncrementalDetokenizer(self.tokenizer, stop=_stops(body))
+            gen = self.engine.generate(sub, list(ids), params)
+            complete = False
+            try:
+                out = await self._collect(gen, detok, sub)
+                complete = True
+                return out
+            finally:
+                if not complete:
+                    self.engine.abort(sub)
+
+        # return_exceptions so one failing child never leaves siblings
+        # running unobserved: every result is collected, surviving children
+        # are aborted explicitly on error, and no "Task exception was never
+        # retrieved" warnings or device-time leaks remain.
+        results = await asyncio.gather(*(one(i) for i in range(n)),
+                                       return_exceptions=True)
+        errors = [r for r in results if isinstance(r, BaseException)]
+        if errors:
+            for i, r in enumerate(results):
+                if not isinstance(r, BaseException):
+                    self.engine.abort(f"{rid}-{i}")
+            self.metrics.on_finish(0)
+            if all(isinstance(e, ValueError) for e in errors):
+                return _error(400, str(errors[0]))
+            raise errors[0]
+        choices = []
+        total_out = 0
+        for i, (text, finish_reason, n_out, tok_ids, tok_lps) in enumerate(results):
+            total_out += n_out
+            choices.append(_choice(kind, i, text, finish_reason,
+                                   self.tokenizer, tok_ids, tok_lps,
+                                   want_lps))
+        self.metrics.on_finish(total_out)
+        return web.json_response(_response_envelope(
+            kind, rid, created, self.model_name, choices,
+            prompt_tokens=len(ids), completion_tokens=total_out))
+
     async def _collect(self, gen, detok: IncrementalDetokenizer, rid: str):
         text = []
         finish_reason = None
@@ -311,16 +370,26 @@ def _map_reason(reason: Optional[str]) -> Optional[str]:
             "abort": "abort"}.get(reason or "", reason)
 
 
-def _response_body(kind, rid, created, model, text, finish_reason, *,
-                   prompt_tokens, completion_tokens) -> dict:
-    choice: dict[str, Any] = {"index": 0, "finish_reason": finish_reason}
+def _choice(kind, index, text, finish_reason, tokenizer, tok_ids, tok_lps,
+            want_lps) -> dict:
+    choice: dict[str, Any] = {"index": index, "finish_reason": finish_reason}
     if kind == "completion":
         choice["text"] = text
+        if want_lps:
+            choice["logprobs"] = {
+                "tokens": [tokenizer.decode([t]) for t in tok_ids],
+                "token_logprobs": tok_lps,
+            }
     else:
         choice["message"] = {"role": "assistant", "content": text}
+    return choice
+
+
+def _response_envelope(kind, rid, created, model, choices, *,
+                       prompt_tokens, completion_tokens) -> dict:
     return {
         "id": rid, "object": kind, "created": created, "model": model,
-        "choices": [choice],
+        "choices": choices,
         "usage": {"prompt_tokens": prompt_tokens,
                   "completion_tokens": completion_tokens,
                   "total_tokens": prompt_tokens + completion_tokens}}

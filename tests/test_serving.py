@@ -359,3 +359,28 @@ class TestLogprobsAPI:
                 "max_tokens": 2, "logprobs": 1})
             assert r.status == 400
         loop.run_until_complete(go())
+
+
+class TestMultipleCompletions:
+    def test_n_choices(self, api_client):
+        """OpenAI n > 1: n concurrent engine requests gathered into indexed
+        choices; greedy n=2 must produce identical texts (deterministic)."""
+        loop, client = api_client
+
+        async def go():
+            r = await client.post("/v1/completions", json={
+                "prompt": [2, 8, 4], "max_tokens": 4, "temperature": 0.0,
+                "n": 2})
+            assert r.status == 200
+            body = await r.json()
+            assert [c["index"] for c in body["choices"]] == [0, 1]
+            assert body["choices"][0]["text"] == body["choices"][1]["text"]
+            assert body["usage"]["completion_tokens"] == 8
+
+            r = await client.post("/v1/completions", json={
+                "prompt": [2, 8], "max_tokens": 2, "n": 2, "stream": True})
+            assert r.status == 400
+            r = await client.post("/v1/completions", json={
+                "prompt": [2, 8], "max_tokens": 2, "n": 0})
+            assert r.status == 400
+        loop.run_until_complete(go())
