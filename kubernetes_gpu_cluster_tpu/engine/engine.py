@@ -31,7 +31,7 @@ import numpy as np
 from ..config import EngineConfig
 from ..models import llama as model_lib
 from ..models.llama import DecodeMeta, PrefillMeta
-from ..ops.sampling import sample_tokens, token_logprobs
+from ..ops.sampling import sample_and_logprobs, token_logprobs
 from ..utils import cdiv, get_logger
 from .kv_cache import KVCache, allocate_kv_cache, derive_num_pages
 from .sampling_params import SamplingParams
@@ -387,9 +387,9 @@ class LLMEngine:
 
         def prefill_step(params, kv: KVCache, int_t, int_b, float_b, key):
             logits, kv = fwd(params, kv, int_t, int_b[:, 0])
-            next_tokens = sample_tokens(logits, key, float_b[:, 0],
-                                        int_b[:, 1], float_b[:, 1])
-            return next_tokens, token_logprobs(logits, next_tokens), kv
+            next_tokens, lps = sample_and_logprobs(
+                logits, key, float_b[:, 0], int_b[:, 1], float_b[:, 1])
+            return next_tokens, lps, kv
 
         return self._maybe_jit(prefill_step, donate_argnums=(1,))
 
@@ -419,9 +419,9 @@ class LLMEngine:
                 use_pallas=use_pallas and attn_mesh is None,
                 attn_mesh=attn_mesh)
             logits = model_lib.compute_logits(params, cfg, hidden)
-            next_tokens = sample_tokens(logits, key, float_b[:, 0],
-                                        int_b[:, 1], float_b[:, 1])
-            return next_tokens, token_logprobs(logits, next_tokens), kv
+            next_tokens, lps = sample_and_logprobs(
+                logits, key, float_b[:, 0], int_b[:, 1], float_b[:, 1])
+            return next_tokens, lps, kv
 
         return self._maybe_jit(prefill_hist_step, donate_argnums=(1,))
 
@@ -506,11 +506,11 @@ class LLMEngine:
                 logits, kv = fwd(params, kv, tokens, m)
                 if greedy:
                     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    lps = token_logprobs(logits, next_tokens)
                 else:
-                    next_tokens = sample_tokens(
+                    next_tokens, lps = sample_and_logprobs(
                         logits, jax.random.fold_in(key, i),
                         temperature, top_k, top_p)
-                lps = token_logprobs(logits, next_tokens)
                 return (kv, next_tokens, pos + 1), (next_tokens, lps)
 
             (kv, _, _), (toks, lps) = jax.lax.scan(

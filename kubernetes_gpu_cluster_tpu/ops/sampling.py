@@ -12,74 +12,166 @@ import jax
 import jax.numpy as jnp
 
 
-def _apply_filters(scaled: jax.Array, top_k: jax.Array,
-                   top_p: jax.Array) -> jax.Array:
-    """Top-k + top-p masks off ONE shared descending sort of the scaled
-    logits. top_k: [B] int32, 0 => disabled; top_p: [B] float32, 1.0 =>
-    disabled. A [B, V] sort is the most expensive op in the whole sampling
-    path on TPU (V=32k), so it runs once, and sample_tokens skips this
-    function entirely at runtime when no row needs it."""
-    V = scaled.shape[-1]
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]        # descending
+# Static width of the lax.top_k fast path. Serving-realistic top_k values
+# (vLLM defaults/docs use <= 100) and top-p prefixes of peaked model
+# distributions fit comfortably; anything wider falls back to the exact
+# full-sort path at runtime (see _apply_filters).
+TOP_K_CAP = 128
 
-    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
-    k_thresh = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)
 
-    # Top-p runs on the RENORMALIZED post-top-k distribution (vLLM order):
-    # in sorted space the top-k mask is just a position cutoff.
+def _filter_thresholds_sorted(sorted_logits: jax.Array, k: jax.Array,
+                              top_p: jax.Array, lse: jax.Array):
+    """Shared top-k/top-p threshold math on DESCENDING-sorted (or top-K
+    truncated) logits. ``lse`` is the logsumexp of the post-top-k-masked row
+    (the renormalizer of the post-top-k distribution, vLLM order). Returns
+    (k_thresh, p_thresh, cum_mass_covered)."""
+    W = sorted_logits.shape[-1]
+    k_idx = jnp.clip(k, 1, W) - 1
+    k_thresh_w = jnp.take_along_axis(sorted_logits, k_idx[:, None], axis=-1)
+    # Rows whose k exceeds the window have no in-window threshold.
+    k_thresh = jnp.where((k[:, None] <= W), k_thresh_w, -jnp.inf)
+
     pos = jax.lax.broadcasted_iota(jnp.int32, sorted_logits.shape, 1)
     k_sorted = jnp.where(pos < k[:, None], sorted_logits, -jnp.inf)
-    sorted_probs = jax.nn.softmax(k_sorted, axis=-1)
+    sorted_probs = jnp.exp(k_sorted - lse[:, None])
     cumsum = jnp.cumsum(sorted_probs, axis=-1)
     # Number of tokens needed to reach mass top_p (always keep >= 1).
     keep = jnp.clip(
-        jnp.sum(cumsum - sorted_probs < top_p[:, None], axis=-1), 1, V)
+        jnp.sum(cumsum - sorted_probs < top_p[:, None], axis=-1), 1, W)
     p_thresh = jnp.take_along_axis(k_sorted, (keep - 1)[:, None], axis=-1)
+    # A disabled row (top_p >= 1) must not be clamped to the window width —
+    # on the truncated fast path that would mask everything below the cap.
+    p_thresh = jnp.where(top_p[:, None] >= 1.0, -jnp.inf, p_thresh)
+    return k_thresh, p_thresh, cumsum[:, -1]
 
-    return jnp.where(scaled < jnp.maximum(k_thresh, p_thresh), -jnp.inf,
-                     scaled)
+
+def _apply_filters(scaled: jax.Array, top_k: jax.Array,
+                   top_p: jax.Array) -> jax.Array:
+    """Top-k + top-p filtering. top_k: [B] int32, 0 => disabled; top_p: [B]
+    float32, 1.0 => disabled. sample_tokens skips this function entirely at
+    runtime when no row needs it.
+
+    Fast path (the serving case): one ``lax.top_k`` to TOP_K_CAP — far
+    cheaper on TPU than the full [B, V] sort (V=32k-128k) that used to cost
+    ~5-7 ms per substep — plus a sort-free full-row logsumexp so top-p mass
+    is still measured against the EXACT post-top-k distribution. A runtime
+    ``lax.cond`` falls back to the full-sort path only when some row
+    actually needs tokens beyond the cap (top_k > cap, or a top-p prefix —
+    e.g. of a near-uniform distribution — wider than the cap), so the
+    semantics match the one-shared-sort implementation (up to float
+    rounding when a cumulative mass lands within ~1 ulp of top_p: the two
+    paths normalize via exp(x - lse) vs softmax division)."""
+    V = scaled.shape[-1]
+    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+
+    def full_sort(scaled):
+        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]    # descending
+        lse = jax.nn.logsumexp(
+            jnp.where(jax.lax.broadcasted_iota(jnp.int32, scaled.shape, 1)
+                      < k[:, None], sorted_logits, -jnp.inf), axis=-1)
+        k_t, p_t, _ = _filter_thresholds_sorted(sorted_logits, k, top_p, lse)
+        return jnp.maximum(k_t, p_t)
+
+    if V <= TOP_K_CAP:
+        thresh = full_sort(scaled)
+        return jnp.where(scaled < thresh, -jnp.inf, scaled)
+
+    top_vals, _ = jax.lax.top_k(scaled, TOP_K_CAP)            # [B, cap] desc
+    k_in_cap = k <= TOP_K_CAP
+    # Post-top-k renormalizer, POSITIONAL like the full-sort path (a value
+    # threshold would over-include logits tied with the k-th value and skew
+    # top-p mass): rows with k inside the cap renormalize over exactly the
+    # first k entries of the descending window; top-k-disabled rows over the
+    # full row. Out-of-cap rows get the full-row value too, but they are
+    # punted to the fallback below before it is ever used.
+    pos = jax.lax.broadcasted_iota(jnp.int32, top_vals.shape, 1)
+    lse_win = jax.nn.logsumexp(
+        jnp.where(pos < k[:, None], top_vals, -jnp.inf), axis=-1)
+    lse = jnp.where(k_in_cap, lse_win, jax.nn.logsumexp(scaled, axis=-1))
+    k_t, p_t, covered = _filter_thresholds_sorted(top_vals, k, top_p, lse)
+
+    # Exact iff every row's filter resolves inside the cap: top_k disabled
+    # or <= cap, and the top-p boundary (if enabled) carries enough mass.
+    ok = jnp.all((k_in_cap | (k >= V))
+                 & ((top_p >= 1.0) | (covered >= top_p)))
+    return jax.lax.cond(
+        ok,
+        lambda s: jnp.where(s < jnp.maximum(k_t, p_t), -jnp.inf, s),
+        lambda s: jnp.where(s < full_sort(s), -jnp.inf, s),
+        scaled)
 
 
-def sample_tokens(
+def sample_and_logprobs(
     logits: jax.Array,        # [B, V] float32
     key: jax.Array,           # PRNG key
     temperature: jax.Array,   # [B] float32; 0 => greedy
     top_k: jax.Array,         # [B] int32; 0 => disabled
     top_p: jax.Array,         # [B] float32; 1.0 => disabled
-) -> jax.Array:
-    """Returns sampled token ids [B] int32. Greedy rows (temperature==0)
-    ignore the random draw entirely.
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (sampled token ids [B] int32, chosen-token logprobs [B] f32).
+    Greedy rows (temperature==0) ignore the random draw entirely and report
+    logprobs of the raw distribution; sampled rows report logprobs under the
+    temperature-scaled (pre-truncation, vLLM-order) distribution — the
+    scaled logits are computed ONCE and shared between the filter stage and
+    the logprob readout.
 
     One compiled program serves heterogeneous batches, but the expensive
     stages are gated by runtime ``lax.cond`` so an all-greedy batch (the
-    common serving case, and the bench) pays for an argmax only — no [B, V]
-    sort, no categorical draw."""
+    common serving case, and the bench) pays for an argmax + one logsumexp
+    only — no [B, V] top_k/sort, no categorical draw."""
     logits = logits.astype(jnp.float32)
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def sampled_path(_):
         safe_temp = jnp.where(temperature <= 0, 1.0, temperature)
-        scaled = logits / safe_temp[:, None]
+        scaled = logits / safe_temp[:, None]   # greedy rows: safe_temp==1
         needs_filter = jnp.any((top_k > 0) | (top_p < 1.0))
         filtered = jax.lax.cond(
             needs_filter, lambda s: _apply_filters(s, top_k, top_p),
             lambda s: s, scaled)
-        return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
+        ids = jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
+        ids = jnp.where(temperature <= 0, greedy_ids, ids)
+        return ids, _chosen_logprobs(scaled, ids)
 
-    sampled_ids = jax.lax.cond(jnp.any(temperature > 0), sampled_path,
-                               lambda _: greedy_ids, None)
-    return jnp.where(temperature <= 0, greedy_ids, sampled_ids)
+    return jax.lax.cond(
+        jnp.any(temperature > 0), sampled_path,
+        lambda _: (greedy_ids, _chosen_logprobs(logits, greedy_ids)), None)
 
 
-def token_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
-    """Log-probability of each chosen token under the UNFILTERED
-    distribution (vLLM reports pre-truncation logprobs): logits [B, V] f32,
-    tokens [B] int32 -> [B] f32. One max-reduce + one logsumexp next to the
-    sampling sorts — negligible, so the step programs compute it
-    unconditionally; the HOST records it per request only when
-    SamplingParams.logprobs is set (engine._process_window)."""
+def sample_tokens(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """Sampled token ids only — see sample_and_logprobs (the logprob output
+    is dead-code-eliminated by XLA when unused)."""
+    return sample_and_logprobs(logits, key, temperature, top_k, top_p)[0]
+
+
+def _chosen_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """log softmax(logits)[tokens]: [B, V] f32, [B] int32 -> [B] f32. One
+    max-reduce + one logsumexp — negligible next to the forward pass, so
+    the step programs compute it unconditionally; the HOST records it per
+    request only when SamplingParams.logprobs is set
+    (engine._process_window)."""
     shifted = logits - jnp.max(logits, axis=-1, keepdims=True)
     lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
     chosen = jnp.take_along_axis(shifted, tokens[:, None].astype(jnp.int32),
                                  axis=-1)[:, 0]
     return chosen - lse
+
+
+def token_logprobs(logits: jax.Array, tokens: jax.Array,
+                   temperature: jax.Array | None = None) -> jax.Array:
+    """Log-probability of each chosen token under the UNFILTERED (but
+    temperature-scaled, matching vLLM's logits-processor order)
+    distribution. Greedy rows (temperature <= 0) report logprobs of the raw
+    distribution, like vLLM's temperature==0 path. Standalone entry for
+    callers that sampled elsewhere (e.g. the all-greedy decode program);
+    sampled step programs get this fused via sample_and_logprobs instead."""
+    if temperature is not None:
+        safe_temp = jnp.where(temperature <= 0, 1.0, temperature)
+        logits = logits / safe_temp[:, None]
+    return _chosen_logprobs(logits, tokens)

@@ -53,9 +53,17 @@ def _logprobs_requested(body: dict):
     chosen-token logprobs. Alternatives (top-k > 1) are not supported —
     only the sampled token's logprob leaves the device."""
     lp = body.get("logprobs")
-    if not lp:
+    if lp is None or lp is False:
         return False, None
-    if lp is True or int(lp) == 1:
+    if lp is True:
+        return True, None
+    if isinstance(lp, float) and lp.is_integer():
+        lp = int(lp)   # json floats: 1.0 and 1 are the same request
+    if not isinstance(lp, int):
+        return False, _error(400, "logprobs must be a boolean or an integer")
+    if lp == 0:
+        return False, None
+    if lp == 1:
         return True, None
     return False, _error(400, "logprobs > 1 (top alternatives) is not "
                               "supported; use logprobs: 1")

@@ -200,5 +200,9 @@ def follower_addrs_from_env() -> list[str]:
             "does not follow the StatefulSet '<name>-0.<svc>:<port>' "
             "pattern; set KGCT_FOLLOWER_ADDRS explicitly")
     host = coord.rpartition(":")[0]
-    return [f"{host.replace('-0.', f'-{k}.', 1)}:{CONTROL_PORT}"
+    # Followers bind KGCT_CONTROL_PORT when set; a StatefulSet template
+    # shares env across ranks, so derive dial addresses from the same
+    # override or the leader would dial the default port forever.
+    port = int(os.environ.get("KGCT_CONTROL_PORT", CONTROL_PORT))
+    return [f"{host.replace('-0.', f'-{k}.', 1)}:{port}"
             for k in range(1, n)]

@@ -1,0 +1,166 @@
+"""Parity tests for ops/sampling.py.
+
+The production `_apply_filters` takes a lax.top_k fast path (TOP_K_CAP wide)
+with a runtime fallback to a full [B, V] sort. Both must match REFERENCE_FILTER
+— the straightforward one-shared-sort implementation (vLLM's logits-processor
+semantics: top-k first, top-p over the renormalized post-top-k distribution) —
+bit-for-bit on the filtered logits.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_gpu_cluster_tpu.ops.sampling import (
+    TOP_K_CAP, _apply_filters, sample_tokens, token_logprobs)
+
+
+def reference_filter(scaled, top_k, top_p):
+    """The original full-sort implementation, kept verbatim as the oracle."""
+    V = scaled.shape[-1]
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+    k_thresh = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)
+    pos = jax.lax.broadcasted_iota(jnp.int32, sorted_logits.shape, 1)
+    k_sorted = jnp.where(pos < k[:, None], sorted_logits, -jnp.inf)
+    sorted_probs = jax.nn.softmax(k_sorted, axis=-1)
+    cumsum = jnp.cumsum(sorted_probs, axis=-1)
+    keep = jnp.clip(
+        jnp.sum(cumsum - sorted_probs < top_p[:, None], axis=-1), 1, V)
+    p_thresh = jnp.take_along_axis(k_sorted, (keep - 1)[:, None], axis=-1)
+    return jnp.where(scaled < jnp.maximum(k_thresh, p_thresh), -jnp.inf,
+                     scaled)
+
+
+def _peaked_logits(rng, B, V, scale=8.0):
+    """Sharply peaked rows so top-p prefixes resolve well inside TOP_K_CAP."""
+    logits = rng.standard_normal((B, V)).astype(np.float32)
+    peak_cols = rng.integers(0, V, (B, 8))
+    for b in range(B):
+        logits[b, peak_cols[b]] += scale
+    return jnp.asarray(logits)
+
+
+@pytest.mark.parametrize("top_k,top_p", [
+    (20, 1.0),            # top-k only
+    (0, 0.9),             # top-p only
+    (20, 0.9),            # both
+    (TOP_K_CAP, 0.5),     # k exactly at the cap
+])
+def test_fast_path_matches_reference_peaked(top_k, top_p):
+    rng = np.random.default_rng(0)
+    B, V = 8, 4096
+    scaled = _peaked_logits(rng, B, V)
+    tk = jnp.full((B,), top_k, jnp.int32)
+    tp = jnp.full((B,), top_p, jnp.float32)
+    got = _apply_filters(scaled, tk, tp)
+    want = reference_filter(scaled, tk, tp)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("top_k,top_p", [
+    (0, 0.9),             # near-uniform: top-p prefix far wider than the cap
+    (TOP_K_CAP + 37, 1.0),  # k beyond the cap
+    (500, 0.95),
+])
+def test_fallback_path_matches_reference_uniform(top_k, top_p):
+    rng = np.random.default_rng(1)
+    B, V = 8, 4096
+    scaled = jnp.asarray(rng.standard_normal((B, V)).astype(np.float32)) * 0.01
+    tk = jnp.full((B,), top_k, jnp.int32)
+    tp = jnp.full((B,), top_p, jnp.float32)
+    got = _apply_filters(scaled, tk, tp)
+    want = reference_filter(scaled, tk, tp)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_mixed_rows_match_reference():
+    """Heterogeneous per-row params: disabled rows, capped rows, p rows."""
+    rng = np.random.default_rng(2)
+    B, V = 6, 2048
+    scaled = _peaked_logits(rng, B, V)
+    tk = jnp.asarray([0, 1, 50, 0, TOP_K_CAP, 7], jnp.int32)
+    tp = jnp.asarray([1.0, 1.0, 0.9, 0.5, 0.99, 0.8], jnp.float32)
+    got = _apply_filters(scaled, tk, tp)
+    want = reference_filter(scaled, tk, tp)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tied_kth_value_matches_reference():
+    """Logits tied with the k-th value must NOT inflate the top-p
+    renormalizer on the fast path (regression: a value-threshold mask kept
+    both tied logits, changing the kept top-p prefix). Ties are realistic
+    with quantized logits."""
+    V = 4096
+    row = np.full((V,), -10.0, np.float32)
+    row[0], row[1], row[2] = 2.0, 1.0, 1.0
+    scaled = jnp.asarray(np.stack([row, row]))
+    tk = jnp.asarray([2, 2], jnp.int32)
+    tp = jnp.asarray([0.7, 0.7], jnp.float32)
+    got = _apply_filters(scaled, tk, tp)
+    want = reference_filter(scaled, tk, tp)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # p(top)=0.731 >= 0.7 under the exact 2-token renormalizer => keep only
+    # the argmax.
+    assert np.isfinite(np.asarray(got)[0]).sum() == 1
+
+
+def test_small_vocab_uses_full_sort():
+    rng = np.random.default_rng(3)
+    B, V = 4, TOP_K_CAP // 2   # V <= cap: static full-sort path
+    scaled = jnp.asarray(rng.standard_normal((B, V)).astype(np.float32))
+    tk = jnp.asarray([0, 3, 10, V], jnp.int32)
+    tp = jnp.asarray([0.9, 1.0, 0.5, 0.7], jnp.float32)
+    got = _apply_filters(scaled, tk, tp)
+    want = reference_filter(scaled, tk, tp)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_sample_tokens_greedy_rows_exact():
+    rng = np.random.default_rng(4)
+    B, V = 8, 512
+    logits = jnp.asarray(rng.standard_normal((B, V)).astype(np.float32))
+    temp = jnp.asarray([0.0, 1.0] * (B // 2), jnp.float32)
+    toks = sample_tokens(logits, jax.random.PRNGKey(0), temp,
+                         jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32))
+    greedy = jnp.argmax(logits, axis=-1)
+    np.testing.assert_array_equal(np.asarray(toks[::2]), np.asarray(greedy[::2]))
+
+
+def test_sample_tokens_respects_top_k_1():
+    """top_k=1 at temperature>0 must always return the argmax."""
+    rng = np.random.default_rng(5)
+    B, V = 8, 4096
+    logits = jnp.asarray(rng.standard_normal((B, V)).astype(np.float32))
+    toks = sample_tokens(logits, jax.random.PRNGKey(7),
+                         jnp.ones((B,), jnp.float32),
+                         jnp.ones((B,), jnp.int32),
+                         jnp.ones((B,), jnp.float32))
+    np.testing.assert_array_equal(np.asarray(toks),
+                                  np.asarray(jnp.argmax(logits, axis=-1)))
+
+
+def test_token_logprobs_temperature_scaling():
+    """Logprobs are reported under the temperature-scaled distribution
+    (vLLM's logits-processor order); greedy rows use the raw distribution."""
+    rng = np.random.default_rng(6)
+    B, V = 4, 256
+    logits = jnp.asarray(rng.standard_normal((B, V)).astype(np.float32))
+    tokens = jnp.asarray(rng.integers(0, V, B), jnp.int32)
+    temp = jnp.asarray([0.0, 1.0, 2.0, 0.5], jnp.float32)
+    got = token_logprobs(logits, tokens, temp)
+
+    logp_raw = jax.nn.log_softmax(logits, axis=-1)
+    logp_t2 = jax.nn.log_softmax(logits / 2.0, axis=-1)
+    logp_h = jax.nn.log_softmax(logits / 0.5, axis=-1)
+    np.testing.assert_allclose(got[0], logp_raw[0, tokens[0]], rtol=1e-5)
+    np.testing.assert_allclose(got[1], logp_raw[1, tokens[1]], rtol=1e-5)
+    np.testing.assert_allclose(got[2], logp_t2[2, tokens[2]], rtol=1e-5)
+    np.testing.assert_allclose(got[3], logp_h[3, tokens[3]], rtol=1e-5)
+
+    # Backwards-compatible default: no temperature arg => raw distribution.
+    got_none = token_logprobs(logits, tokens)
+    np.testing.assert_allclose(np.asarray(got_none),
+                               np.asarray(logp_raw[jnp.arange(B), tokens]),
+                               rtol=1e-5)
