@@ -74,6 +74,25 @@ class RequestOutput:
     output_logprobs: Optional[list[float]] = None  # full per-token record
 
 
+def resolve_shardings(mesh, model_cfg):
+    """(params_sharding, kv_sharding) for a serving mesh — the one place
+    that picks between GSPMD Megatron layouts (parallel/sharding.py) and the
+    manual pipeline layout (parallel/pp.py: layer axis over ``pp``, Megatron
+    tp inside stages — the engine-side integration the reference got from
+    Ray + vLLM, reference values-01-minimal-example4.yaml:16-23). Used by
+    the engine at init AND by weight loading, so checkpoints stream straight
+    into their sharded placement (engine/weights._load_streamed)."""
+    if mesh is None:
+        return None, None
+    if mesh.shape.get("pp", 1) > 1:
+        from ..parallel.pp import (pp_kv_sharding, pp_param_shardings,
+                                   validate_pp_mesh)
+        validate_pp_mesh(mesh, model_cfg)
+        return pp_param_shardings(mesh, model_cfg), pp_kv_sharding(mesh)
+    from ..parallel.sharding import kv_cache_sharding, param_shardings
+    return param_shardings(mesh, model_cfg), kv_cache_sharding(mesh, model_cfg)
+
+
 class LLMEngine:
     def __init__(self, config: EngineConfig, params=None,
                  eos_token_id: Optional[int] = None,
@@ -124,23 +143,9 @@ class LLMEngine:
 
         self.scheduler = Scheduler(config, num_pages)
 
-        kv_sharding = params_sharding = None
+        params_sharding, kv_sharding = resolve_shardings(mesh, config.model)
         if mesh is not None and self.pp_size > 1:
-            # Pipeline serving: params/KV live in the shard_map layout (layer
-            # axis over pp, Megatron tp inside stages) and every step runs the
-            # circular pipeline of parallel/pp.py. This is the engine-side
-            # integration the reference got from Ray + vLLM
-            # (pipelineParallelSize, reference values-01-minimal-example4.yaml:16-23).
-            from ..parallel.pp import (pp_kv_sharding, pp_param_shardings,
-                                       validate_pp_mesh)
-            validate_pp_mesh(mesh, config.model)
-            kv_sharding = pp_kv_sharding(mesh)
-            params_sharding = pp_param_shardings(mesh, config.model)
             logger.info("pipeline-parallel serving: %s", dict(mesh.shape))
-        elif mesh is not None:
-            from ..parallel.sharding import kv_cache_sharding, param_shardings
-            kv_sharding = kv_cache_sharding(mesh, config.model)
-            params_sharding = param_shardings(mesh, config.model)
 
         if params is None:
             logger.info("initializing random weights for %s", config.model.name)

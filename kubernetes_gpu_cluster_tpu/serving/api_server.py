@@ -540,8 +540,12 @@ def main(argv: Optional[list[str]] = None) -> None:
     mesh = mesh_from_config(config.parallel)
     params = None
     if args.weights:
+        from ..engine.engine import resolve_shardings
         from ..engine.weights import load_weights
-        params = load_weights(args.weights, config.model)
+        # Stream straight into the mesh placement: each host reads only its
+        # shards' byte ranges (host RSS ~ model/world, the 70B story).
+        shardings, _ = resolve_shardings(mesh, config.model)
+        params = load_weights(args.weights, config.model, shardings=shardings)
     if follower is not None:
         # Rank > 0 of a multi-process mesh: no HTTP API — build the same
         # engine and serve step directives from rank 0 (SPMD lockstep; see
