@@ -31,7 +31,9 @@ import numpy as np
 from ..config import EngineConfig
 from ..models import llama as model_lib
 from ..models.llama import DecodeMeta, PrefillMeta
-from ..ops.sampling import sample_and_logprobs, token_logprobs
+from ..ops.sampling import (apply_penalties, build_counts, bump_counts,
+                            row_sample_keys, sample_and_logprobs,
+                            token_logprobs)
 from ..utils import cdiv, get_logger
 from .kv_cache import KVCache, allocate_kv_cache, derive_num_pages
 from .sampling_params import SamplingParams
@@ -175,6 +177,9 @@ class LLMEngine:
         # Speculative decode-window chain state (see step()).
         self._inflight: Optional[dict] = None
         self._deferred_release: list[Sequence] = []
+        # Width of the host->device output-token resync buffer for the
+        # penalty histogram (outputs are bounded by the model length).
+        self._out_cap = config.effective_max_len
 
     def _resolve_use_pallas(self, use_pallas: Optional[bool]) -> bool:
         """Decide the kernel path ONCE, at init, from static facts — backend,
@@ -391,9 +396,13 @@ class LLMEngine:
                 return model_lib.compute_logits(params, cfg, hidden), kv
 
         def prefill_step(params, kv: KVCache, int_t, int_b, float_b, key):
+            # int_b: [B, 3] = (logits_indices, top_k, seed)
             logits, kv = fwd(params, kv, int_t, int_b[:, 0])
+            pos_next = jnp.take(int_t[2], int_b[:, 0]) + 1
+            keys = row_sample_keys(key, int_b[:, 2], pos_next)
             next_tokens, lps = sample_and_logprobs(
-                logits, key, float_b[:, 0], int_b[:, 1], float_b[:, 1])
+                logits, keys, float_b[:, 0], int_b[:, 1], float_b[:, 1],
+                row_keys=True)
             return next_tokens, lps, kv
 
         return self._maybe_jit(prefill_step, donate_argnums=(1,))
@@ -424,8 +433,11 @@ class LLMEngine:
                 use_pallas=use_pallas and attn_mesh is None,
                 attn_mesh=attn_mesh)
             logits = model_lib.compute_logits(params, cfg, hidden)
+            pos_next = jnp.take(int_t[2], int_b[:, 0]) + 1
+            keys = row_sample_keys(key, int_b[:, 2], pos_next)
             next_tokens, lps = sample_and_logprobs(
-                logits, key, float_b[:, 0], int_b[:, 1], float_b[:, 1])
+                logits, keys, float_b[:, 0], int_b[:, 1], float_b[:, 1],
+                row_keys=True)
             return next_tokens, lps, kv
 
         return self._maybe_jit(prefill_hist_step, donate_argnums=(1,))
@@ -479,50 +491,94 @@ class LLMEngine:
                     attn_mesh=attn_mesh)
                 return model_lib.compute_logits(params, cfg, hidden), kv
 
-        def decode_window(params, kv: KVCache, tokens0, int_b, float_b, key):
+        V = cfg.vocab_size
+
+        def substep_meta(page_tables, pos):
+            # Window substeps past the model length cap produce tokens the
+            # host discards — but their KV writes still happen on device.
+            # Route them to the scrap page (page 0) instead of clamping
+            # into the sequence's real pages, where the write would wrap
+            # (pos % ps) and overwrite earlier KV.
+            pos_c = jnp.minimum(pos, max_len - 1)
+            page_idx = pos_c // ps
+            page = jnp.take_along_axis(page_tables, page_idx[:, None],
+                                       axis=1)[:, 0]
+            in_range = pos < max_len
+            slot = jnp.where(in_range, page * ps + pos_c % ps, pos % ps)
+            return DecodeMeta(positions=pos_c, slot_mapping=slot,
+                              page_tables=page_tables, context_lens=pos_c + 1)
+
+        def decode_window_greedy(params, kv: KVCache, tokens0, int_b,
+                                 float_b, key):
             # tokens0: [B] — separate so chained windows can feed the previous
             # window's device-resident output column without a host roundtrip.
-            # int_b: [B, pps+2] = (positions, top_k, page_table...),
-            # float_b: [B, 2] = (temperature, top_p). Slots/context lens are
-            # recomputed per sub-step from positions + page tables.
+            # int_b: [B, pps+3] = (positions, top_k, seed, page_table...),
+            # float_b: [B, 4] = (temperature, top_p, presence, frequency).
+            # Slots/context lens are recomputed per sub-step from positions +
+            # page tables. The greedy program ignores the sampling columns —
+            # it is only dispatched for all-greedy, penalty-free batches.
             positions0 = int_b[:, 0]
-            top_k = int_b[:, 1]
-            page_tables = int_b[:, 2:]
-            temperature = float_b[:, 0]
-            top_p = float_b[:, 1]
+            page_tables = int_b[:, 3:]
 
             def substep(carry, i):
                 kv, tokens, pos = carry
-                # Window substeps past the model length cap produce tokens the
-                # host discards — but their KV writes still happen on device.
-                # Route them to the scrap page (page 0) instead of clamping
-                # into the sequence's real pages, where the write would wrap
-                # (pos % ps) and overwrite earlier KV.
-                pos_c = jnp.minimum(pos, max_len - 1)
-                page_idx = pos_c // ps
-                page = jnp.take_along_axis(page_tables, page_idx[:, None],
-                                           axis=1)[:, 0]
-                in_range = pos < max_len
-                slot = jnp.where(in_range, page * ps + pos_c % ps, pos % ps)
-                m = DecodeMeta(positions=pos_c,
-                               slot_mapping=slot,
-                               page_tables=page_tables,
-                               context_lens=pos_c + 1)
-                logits, kv = fwd(params, kv, tokens, m)
-                if greedy:
-                    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    lps = token_logprobs(logits, next_tokens)
-                else:
-                    next_tokens, lps = sample_and_logprobs(
-                        logits, jax.random.fold_in(key, i),
-                        temperature, top_k, top_p)
+                logits, kv = fwd(params, kv, tokens,
+                                 substep_meta(page_tables, pos))
+                next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                lps = token_logprobs(logits, next_tokens)
                 return (kv, next_tokens, pos + 1), (next_tokens, lps)
 
             (kv, _, _), (toks, lps) = jax.lax.scan(
                 substep, (kv, tokens0, positions0), jnp.arange(W))
             return toks.T, lps.T, kv    # [B, W] each
 
-        return self._maybe_jit(decode_window, donate_argnums=(1,))
+        def decode_window_sampled(params, kv: KVCache, tokens0, int_b,
+                                  float_b, key, counts, out_tokens, rebuild):
+            # Sampled variant adds per-request seed + presence/frequency
+            # penalties (vLLM semantics: over generated tokens only). counts
+            # [B, V] i32 is the device-resident output-token histogram: it
+            # is REBUILT from host-known output ids (out_tokens, -1-padded)
+            # when the batch composition changed, and CARRIED (donated
+            # through the chain) across speculatively chained windows — so
+            # penalties see the in-flight window's tokens the host hasn't
+            # downloaded yet.
+            positions0 = int_b[:, 0]
+            top_k = int_b[:, 1]
+            seed = int_b[:, 2]
+            page_tables = int_b[:, 3:]
+            temperature = float_b[:, 0]
+            top_p = float_b[:, 1]
+            presence = float_b[:, 2]
+            frequency = float_b[:, 3]
+            any_pen = jnp.any((presence != 0.0) | (frequency != 0.0))
+            counts = jax.lax.cond(
+                rebuild, lambda c: build_counts(out_tokens, V),
+                lambda c: c, counts)
+
+            def substep(carry, i):
+                kv, counts, tokens, pos = carry
+                logits, kv = fwd(params, kv, tokens,
+                                 substep_meta(page_tables, pos))
+                logits = jax.lax.cond(
+                    any_pen,
+                    lambda l: apply_penalties(l, counts, presence, frequency),
+                    lambda l: l, logits)
+                keys = row_sample_keys(key, seed, pos + 1)
+                next_tokens, lps = sample_and_logprobs(
+                    logits, keys, temperature, top_k, top_p, row_keys=True)
+                counts = jax.lax.cond(
+                    any_pen, lambda c: bump_counts(c, next_tokens),
+                    lambda c: c, counts)
+                return (kv, counts, next_tokens, pos + 1), (next_tokens, lps)
+
+            (kv, counts, _, _), (toks, lps) = jax.lax.scan(
+                substep, (kv, counts, tokens0, positions0), jnp.arange(W))
+            return toks.T, lps.T, kv, counts
+
+        if greedy:
+            return self._maybe_jit(decode_window_greedy, donate_argnums=(1,))
+        # counts (arg 6) rides the chain donated, like the KV pool.
+        return self._maybe_jit(decode_window_sampled, donate_argnums=(1, 6))
 
     # -- public API ---------------------------------------------------------
 
@@ -587,14 +643,15 @@ class LLMEngine:
                 return drained
             self.step_count += 1
             self._key, step_key = jax.random.split(self._key)
-            float_b = jnp.asarray(
-                np.stack([batch.temperature, batch.top_p], axis=1))
+            float_b = jnp.asarray(np.stack(
+                [batch.temperature, batch.top_p, batch.presence,
+                 batch.frequency], axis=1))
             if batch.kind == "prefill":
                 int_t = jnp.asarray(np.stack(
                     [batch.tokens, batch.seg_ids, batch.positions,
                      batch.slot_mapping]))
                 int_b = jnp.asarray(np.stack(
-                    [batch.logits_indices, batch.top_k], axis=1))
+                    [batch.logits_indices, batch.top_k, batch.seed], axis=1))
                 if batch.hist_len is not None:
                     # Chunked prefill (solo): chunk attends to pool history.
                     self.stats.prefill_tokens += int(
@@ -638,17 +695,48 @@ class LLMEngine:
         return outputs
 
     def _dispatch_window(self, batch: ScheduledBatch, tokens_dev,
-                         positions: np.ndarray, float_b) -> dict:
+                         positions: np.ndarray, float_b,
+                         counts=None) -> dict:
         int_b = jnp.asarray(np.concatenate(
-            [np.stack([positions, batch.top_k], axis=1), batch.page_tables],
-            axis=1))
+            [np.stack([positions, batch.top_k, batch.seed], axis=1),
+             batch.page_tables], axis=1))
         self._key, step_key = jax.random.split(self._key)
-        fn = (self._decode_fn_greedy if bool(np.all(batch.temperature <= 0))
-              else self._decode_fn)
-        dev_out, dev_lp, self.kv_cache = fn(
-            self.params, self.kv_cache, tokens_dev, int_b, float_b, step_key)
+        greedy = (bool(np.all(batch.temperature <= 0))
+                  and not np.any(batch.presence)
+                  and not np.any(batch.frequency))
+        if greedy:
+            dev_out, dev_lp, self.kv_cache = self._decode_fn_greedy(
+                self.params, self.kv_cache, tokens_dev, int_b, float_b,
+                step_key)
+            counts = None
+        else:
+            B = len(batch.temperature)
+            any_pen = bool(np.any(batch.presence) or np.any(batch.frequency))
+            rebuild = counts is None and any_pen
+            if counts is None:
+                counts = jnp.zeros((B, self.model_config.vocab_size),
+                                   jnp.int32)
+            if rebuild:
+                # Fresh (non-chained) window with penalties active: re-sync
+                # the histogram from host-known output tokens. Chained
+                # successors carry the device-resident counts instead (they
+                # already include the in-flight window's tokens), and
+                # penalty-free sampled batches (the common case) skip the
+                # host assembly + upload + scatter entirely — counts stay a
+                # device zero-fill that apply_penalties never reads.
+                out_tokens = np.full((B, self._out_cap), -1, np.int32)
+                for s, seq in enumerate(batch.seqs):
+                    ids = seq.output_token_ids[:self._out_cap]
+                    out_tokens[s, :len(ids)] = ids
+                out_tokens = jnp.asarray(out_tokens)
+            else:
+                out_tokens = jnp.full((B, self._out_cap), -1, jnp.int32)
+            dev_out, dev_lp, self.kv_cache, counts = self._decode_fn(
+                self.params, self.kv_cache, tokens_dev, int_b, float_b,
+                step_key, counts, out_tokens, jnp.asarray(rebuild))
         return {"batch": batch, "dev_out": dev_out, "dev_lp": dev_lp,
-                "positions": positions, "float_b": float_b, "zombies": set()}
+                "positions": positions, "float_b": float_b, "zombies": set(),
+                "counts": counts}
 
     def _advance_window(self, inflight: dict) -> Optional[dict]:
         """Build + dispatch the speculative successor window: same batch
@@ -675,7 +763,8 @@ class LLMEngine:
             batch.page_tables[s, :len(seq.pages)] = seq.pages
         self.step_count += 1
         return self._dispatch_window(batch, inflight["dev_out"][:, -1],
-                                     new_positions, inflight["float_b"])
+                                     new_positions, inflight["float_b"],
+                                     counts=inflight.get("counts"))
 
     def _process_window(self, batch: ScheduledBatch, next_tokens: np.ndarray,
                         logprobs: np.ndarray, zombies: set,
@@ -762,11 +851,16 @@ class LLMEngine:
     # -- convenience --------------------------------------------------------
 
     def generate(self, prompts: list[list[int]],
-                 params: Optional[SamplingParams] = None,
-                 ) -> list[RequestOutput]:
-        """Synchronous batch generation (offline / test path)."""
-        for i, p in enumerate(prompts):
-            self.add_request(f"req-{i}", p, params)
+                 params=None) -> list[RequestOutput]:
+        """Synchronous batch generation (offline / test path). ``params``:
+        one SamplingParams for all prompts, or a list of one per prompt."""
+        plist = (list(params) if isinstance(params, (list, tuple))
+                 else [params] * len(prompts))
+        if len(plist) != len(prompts):
+            raise ValueError(f"got {len(plist)} SamplingParams for "
+                             f"{len(prompts)} prompts")
+        for i, (p, sp) in enumerate(zip(prompts, plist)):
+            self.add_request(f"req-{i}", p, sp)
         final: dict[str, RequestOutput] = {}
         while self.has_unfinished_requests():
             for out in self.step():

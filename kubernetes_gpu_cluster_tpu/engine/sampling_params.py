@@ -2,7 +2,10 @@
 
 Matches the request surface the reference's vLLM router exposed on
 :30080 (reference ``old_README.md:1472-1476``): temperature, top_p, top_k,
-max_tokens, stop, plus greedy when temperature == 0.
+max_tokens, stop, greedy when temperature == 0, presence/frequency
+penalties over the generated text (vLLM semantics: output tokens only,
+applied before temperature scaling), and a per-request ``seed`` for
+reproducible sampling.
 """
 
 from __future__ import annotations
@@ -20,6 +23,9 @@ class SamplingParams:
     stop_token_ids: Sequence[int] = ()
     ignore_eos: bool = False
     logprobs: bool = False
+    presence_penalty: float = 0.0   # [-2, 2]; flat penalty on seen tokens
+    frequency_penalty: float = 0.0  # [-2, 2]; scales with occurrence count
+    seed: Optional[int] = None      # reproducible sampling per request
 
     def __post_init__(self):
         if self.max_tokens < 1:
@@ -30,3 +36,9 @@ class SamplingParams:
             raise ValueError("top_p must be in (0, 1]")
         if self.top_k < 0:
             raise ValueError("top_k must be >= 0")
+        if not (-2.0 <= self.presence_penalty <= 2.0):
+            raise ValueError("presence_penalty must be in [-2, 2]")
+        if not (-2.0 <= self.frequency_penalty <= 2.0):
+            raise ValueError("frequency_penalty must be in [-2, 2]")
+        if self.seed is not None and not isinstance(self.seed, int):
+            raise ValueError("seed must be an integer")

@@ -59,6 +59,9 @@ class ScheduledBatch:
     temperature: Optional[np.ndarray] = None
     top_k: Optional[np.ndarray] = None
     top_p: Optional[np.ndarray] = None
+    presence: Optional[np.ndarray] = None
+    frequency: Optional[np.ndarray] = None
+    seed: Optional[np.ndarray] = None      # -1 = unseeded
 
     @property
     def num_seqs(self) -> int:
@@ -440,8 +443,18 @@ class Scheduler:
         temperature = np.zeros(B, np.float32)   # padding rows sample greedily
         top_k = np.zeros(B, np.int32)
         top_p = np.ones(B, np.float32)
+        presence = np.zeros(B, np.float32)
+        frequency = np.zeros(B, np.float32)
+        seed = np.full(B, -1, np.int32)
         for s, seq in enumerate(seqs):
             temperature[s] = seq.params.temperature
             top_k[s] = seq.params.top_k
             top_p[s] = seq.params.top_p
-        return dict(temperature=temperature, top_k=top_k, top_p=top_p)
+            presence[s] = seq.params.presence_penalty
+            frequency[s] = seq.params.frequency_penalty
+            if seq.params.seed is not None:
+                # OpenAI accepts any integer seed; the device key derivation
+                # wants a non-negative int32, so fold into 31 bits here.
+                seed[s] = seq.params.seed & 0x7fffffff
+        return dict(temperature=temperature, top_k=top_k, top_p=top_p,
+                    presence=presence, frequency=frequency, seed=seed)

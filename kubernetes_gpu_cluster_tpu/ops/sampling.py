@@ -101,12 +101,62 @@ def _apply_filters(scaled: jax.Array, top_k: jax.Array,
         scaled)
 
 
+def apply_penalties(logits: jax.Array, counts: jax.Array,
+                    presence: jax.Array, frequency: jax.Array) -> jax.Array:
+    """OpenAI/vLLM presence+frequency penalties over the GENERATED text
+    (vLLM semantics: output tokens only, prompt excluded), applied to the
+    raw logits BEFORE temperature scaling — vLLM's logits-processor order.
+    counts: [B, V] int32 occurrence counts of output tokens so far."""
+    c = counts.astype(logits.dtype)
+    return (logits - presence[:, None] * (c > 0)
+            - frequency[:, None] * c)
+
+
+def build_counts(out_tokens: jax.Array, vocab_size: int) -> jax.Array:
+    """[B, CAP] -1-padded output-token ids -> [B, V] int32 counts (one
+    scatter-add; runs once per decode window when the host re-synchronizes
+    the penalty state after a batch-composition change)."""
+    B = out_tokens.shape[0]
+    valid = out_tokens >= 0
+    ids = jnp.where(valid, out_tokens, 0)
+    zeros = jnp.zeros((B, vocab_size), jnp.int32)
+    return zeros.at[jnp.arange(B)[:, None], ids].add(valid.astype(jnp.int32))
+
+
+def bump_counts(counts: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Register one freshly sampled token per row (inside the decode window
+    scan, so chained windows see tokens the host hasn't downloaded yet)."""
+    return counts.at[jnp.arange(tokens.shape[0]), tokens].add(1)
+
+
+def row_sample_keys(step_key: jax.Array, seed: jax.Array,
+                    pos_next: jax.Array) -> jax.Array:
+    """Per-row PRNG keys [B]. Rows with seed >= 0 derive from a FIXED base
+    folded with (seed, absolute position of the sampled token) — the same
+    request with the same seed reproduces its tokens across engines,
+    batches, and window boundaries (vLLM per-request seed semantics). Rows
+    with seed < 0 derive from the engine's step key folded with (row,
+    position) — fresh randomness every window."""
+    base0 = jax.random.key(0)
+    rows = jnp.arange(seed.shape[0], dtype=jnp.int32)
+
+    def one(s, r, p):
+        ks = jax.random.fold_in(jax.random.fold_in(base0, jnp.maximum(s, 0)),
+                                p)
+        ku = jax.random.fold_in(jax.random.fold_in(step_key, r), p)
+        return jnp.where(s >= 0, jax.random.key_data(ks),
+                         jax.random.key_data(ku))
+
+    return jax.random.wrap_key_data(jax.vmap(one)(seed, rows, pos_next))
+
+
 def sample_and_logprobs(
     logits: jax.Array,        # [B, V] float32
-    key: jax.Array,           # PRNG key
+    key: jax.Array,           # PRNG key, or [B] per-row keys (row_keys=True)
     temperature: jax.Array,   # [B] float32; 0 => greedy
     top_k: jax.Array,         # [B] int32; 0 => disabled
     top_p: jax.Array,         # [B] float32; 1.0 => disabled
+    row_keys: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (sampled token ids [B] int32, chosen-token logprobs [B] f32).
     Greedy rows (temperature==0) ignore the random draw entirely and report
@@ -129,8 +179,12 @@ def sample_and_logprobs(
         filtered = jax.lax.cond(
             needs_filter, lambda s: _apply_filters(s, top_k, top_p),
             lambda s: s, scaled)
-        ids = jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
-        ids = jnp.where(temperature <= 0, greedy_ids, ids)
+        if row_keys:
+            ids = jax.vmap(
+                lambda k, row: jax.random.categorical(k, row))(key, filtered)
+        else:
+            ids = jax.random.categorical(key, filtered, axis=-1)
+        ids = jnp.where(temperature <= 0, greedy_ids, ids.astype(jnp.int32))
         return ids, _chosen_logprobs(scaled, ids)
 
     return jax.lax.cond(

@@ -37,6 +37,7 @@ logger = get_logger("serving.api")
 
 
 def _sampling_params(body: dict, eos_token_id: Optional[int]) -> SamplingParams:
+    seed = body.get("seed")
     return SamplingParams(
         max_tokens=int(body.get("max_tokens") or 256),
         temperature=float(body.get("temperature", 1.0)),
@@ -45,6 +46,9 @@ def _sampling_params(body: dict, eos_token_id: Optional[int]) -> SamplingParams:
         stop_token_ids=tuple([eos_token_id] if eos_token_id is not None else [])
         + tuple(body.get("stop_token_ids") or ()),
         logprobs=bool(body.get("logprobs")),
+        presence_penalty=float(body.get("presence_penalty", 0.0)),
+        frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+        seed=int(seed) if seed is not None else None,
     )
 
 
@@ -202,7 +206,18 @@ class APIServer:
         if want_lps and kind != "completion":
             return _error(400, "logprobs are supported on /v1/completions "
                                "only")
-        params = _sampling_params(body, self.tokenizer.eos_token_id)
+        echo = bool(body.get("echo"))
+        if echo and kind != "completion":
+            return _error(400, "echo is supported on /v1/completions only")
+        # Prompt-token logprobs never leave the device (prefill computes
+        # logits only at the last prompt position), so echo+logprobs reports
+        # null for prompt tokens — OpenAI's null-first-token pattern applied
+        # to the whole prompt; documented in PARITY.md.
+        echo_prefix = self.tokenizer.decode(ids) if echo else ""
+        try:
+            params = _sampling_params(body, self.tokenizer.eos_token_id)
+        except (TypeError, ValueError) as e:
+            return _error(400, str(e))
         detok = IncrementalDetokenizer(self.tokenizer, stop=_stops(body))
         rid = self.engine.next_request_id(
             "cmpl" if kind == "completion" else "chatcmpl")
@@ -220,7 +235,7 @@ class APIServer:
             if stream:
                 return _error(400, "n > 1 with stream is not supported")
             return await self._run_n(body, ids, params, kind, rid, created,
-                                     n, want_lps)
+                                     n, want_lps, echo_prefix)
         self.metrics.on_request()
 
         # ``complete`` guards the engine-side abort: any early handler exit —
@@ -243,6 +258,11 @@ class APIServer:
                 if not complete:
                     self.engine.abort(rid)
             self.metrics.on_finish(n_out)
+            if echo:
+                text = echo_prefix + text
+                if want_lps:
+                    tok_ids = list(ids) + tok_ids
+                    tok_lps = [None] * len(ids) + tok_lps
             return web.json_response(_response_envelope(
                 kind, rid, created, self.model_name,
                 [_choice(kind, 0, text, finish_reason, self.tokenizer,
@@ -253,6 +273,9 @@ class APIServer:
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache"})
         await resp.prepare(request)
+        if echo:
+            await resp.write(_sse(_stream_body(
+                kind, rid, created, self.model_name, echo_prefix, None)))
         n_out = 0
         try:
             async for chunk in gen:
@@ -297,7 +320,7 @@ class APIServer:
         return resp
 
     async def _run_n(self, body, ids, params, kind, rid, created, n,
-                     want_lps) -> web.Response:
+                     want_lps, echo_prefix="") -> web.Response:
         """OpenAI ``n`` > 1: n engine requests for one prompt, gathered
         concurrently into n choices (with prefix caching enabled the n-1
         duplicates reuse the prompt's KV pages). Greedy sampling yields n
@@ -309,7 +332,15 @@ class APIServer:
         async def one(i):
             sub = f"{rid}-{i}"
             detok = IncrementalDetokenizer(self.tokenizer, stop=_stops(body))
-            gen = self.engine.generate(sub, list(ids), params)
+            # Seeded n>1: each choice gets a derived sub-seed (choice 0 keeps
+            # the base seed, matching n=1) — same request => same n choices,
+            # but the choices differ from each other (OpenAI/vLLM behavior).
+            p_i = params
+            if params.seed is not None and i > 0:
+                import dataclasses
+                p_i = dataclasses.replace(
+                    params, seed=(params.seed + i) & 0x7fffffff)
+            gen = self.engine.generate(sub, list(ids), p_i)
             complete = False
             try:
                 out = await self._collect(gen, detok, sub)
@@ -338,6 +369,11 @@ class APIServer:
         total_out = 0
         for i, (text, finish_reason, n_out, tok_ids, tok_lps) in enumerate(results):
             total_out += n_out
+            if echo_prefix:
+                text = echo_prefix + text
+                if want_lps:
+                    tok_ids = list(ids) + tok_ids
+                    tok_lps = [None] * len(ids) + tok_lps
             choices.append(_choice(kind, i, text, finish_reason,
                                    self.tokenizer, tok_ids, tok_lps,
                                    want_lps))

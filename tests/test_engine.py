@@ -76,6 +76,57 @@ def test_sampling_params_validation():
         SamplingParams(top_p=0.0)
 
 
+def test_seed_reproducible_across_engines_and_batchmates():
+    """vLLM per-request seed semantics: same prompt + same seed => same
+    tokens, independent of the engine's global PRNG state, batch position,
+    or window boundaries. Different seeds diverge."""
+    prompt = [5, 9, 2, 7]
+    p42 = SamplingParams(max_tokens=12, temperature=1.0, seed=42)
+    eng = make_engine()
+    outs = eng.generate([prompt, prompt, prompt],
+                        [p42, p42, SamplingParams(max_tokens=12,
+                                                  temperature=1.0, seed=7)])
+    assert outs[0].output_token_ids == outs[1].output_token_ids
+    assert outs[0].output_token_ids != outs[2].output_token_ids
+
+    eng2 = make_engine()       # fresh engine, different global key state
+    eng2.generate([[1, 2]], SamplingParams(max_tokens=3, temperature=1.0))
+    again = eng2.generate([prompt], p42)[0]
+    assert again.output_token_ids == outs[0].output_token_ids
+
+
+def test_frequency_penalty_suppresses_repeats():
+    """Near-greedy sampling with a strong frequency penalty: every
+    repetition costs 2.0 logits, far above debug-tiny's logit gaps, so the
+    output cannot dwell on one token; counts must persist across chained
+    decode windows (window=4 < max_tokens=16)."""
+    eng = make_engine()
+    prompt = [3, 1, 4]
+    base = eng.generate([prompt], SamplingParams(
+        max_tokens=16, temperature=0.01, seed=0))[0]
+    pen = eng.generate([prompt], SamplingParams(
+        max_tokens=16, temperature=0.01, seed=0,
+        frequency_penalty=2.0))[0]
+    counts = {}
+    for t in pen.output_token_ids:
+        counts[t] = counts.get(t, 0) + 1
+    assert max(counts.values()) <= 3, (pen.output_token_ids, counts)
+    assert len(set(pen.output_token_ids)) > len(set(base.output_token_ids)) \
+        or base.output_token_ids == pen.output_token_ids
+
+
+def test_penalty_params_validated():
+    with pytest.raises(ValueError):
+        SamplingParams(presence_penalty=3.0)
+    with pytest.raises(ValueError):
+        SamplingParams(frequency_penalty=-2.5)
+    with pytest.raises(ValueError):
+        SamplingParams(seed="abc")
+    # OpenAI accepts any integer seed (negative/64-bit are folded to 31
+    # bits at batch-assembly time).
+    assert SamplingParams(seed=-1).seed == -1
+
+
 def test_stochastic_sampling_runs():
     eng = make_engine()
     outs = eng.generate([[1, 2, 3]] * 2,

@@ -13,7 +13,8 @@ import numpy as np
 import pytest
 
 from kubernetes_gpu_cluster_tpu.ops.sampling import (
-    TOP_K_CAP, _apply_filters, sample_tokens, token_logprobs)
+    TOP_K_CAP, _apply_filters, apply_penalties, build_counts, bump_counts,
+    row_sample_keys, sample_and_logprobs, sample_tokens, token_logprobs)
 
 
 def reference_filter(scaled, top_k, top_p):
@@ -139,6 +140,65 @@ def test_sample_tokens_respects_top_k_1():
                          jnp.ones((B,), jnp.float32))
     np.testing.assert_array_equal(np.asarray(toks),
                                   np.asarray(jnp.argmax(logits, axis=-1)))
+
+
+def test_penalty_counts_and_application():
+    """build_counts / bump_counts / apply_penalties implement the OpenAI
+    presence+frequency formula over output-token occurrence counts."""
+    out = jnp.asarray([[3, 3, 5, -1], [-1, -1, -1, -1]], jnp.int32)
+    counts = build_counts(out, vocab_size=8)
+    expect = np.zeros((2, 8), np.int32)
+    expect[0, 3], expect[0, 5] = 2, 1
+    np.testing.assert_array_equal(np.asarray(counts), expect)
+
+    counts = bump_counts(counts, jnp.asarray([5, 0], jnp.int32))
+    expect[0, 5], expect[1, 0] = 2, 1
+    np.testing.assert_array_equal(np.asarray(counts), expect)
+
+    logits = jnp.zeros((2, 8), jnp.float32)
+    pres = jnp.asarray([0.5, 0.0], jnp.float32)
+    freq = jnp.asarray([0.25, 0.0], jnp.float32)
+    pen = np.asarray(apply_penalties(logits, counts, pres, freq))
+    # row 0: token 3 seen twice -> -(0.5 + 0.25*2) = -1.0; token 5 -> -1.0;
+    # unseen tokens untouched. row 1: no penalties configured.
+    assert pen[0, 3] == pytest.approx(-1.0)
+    assert pen[0, 5] == pytest.approx(-1.0)
+    assert pen[0, 0] == 0.0 and np.all(pen[1] == 0.0)
+
+
+def test_row_sample_keys_seeded_deterministic():
+    """Seeded rows ignore the engine step key (reproducible across engines
+    and window boundaries); unseeded rows follow it."""
+    seed = jnp.asarray([42, -1], jnp.int32)
+    pos = jnp.asarray([7, 7], jnp.int32)
+    k1 = jax.random.key_data(row_sample_keys(jax.random.key(1), seed, pos))
+    k2 = jax.random.key_data(row_sample_keys(jax.random.key(2), seed, pos))
+    np.testing.assert_array_equal(np.asarray(k1[0]), np.asarray(k2[0]))
+    assert not np.array_equal(np.asarray(k1[1]), np.asarray(k2[1]))
+    # a different position changes the seeded key (new draw per token)
+    k3 = jax.random.key_data(row_sample_keys(
+        jax.random.key(1), seed, jnp.asarray([8, 7], jnp.int32)))
+    assert not np.array_equal(np.asarray(k1[0]), np.asarray(k3[0]))
+
+
+def test_sample_and_logprobs_row_keys_seeded_rows_reproduce():
+    rng = np.random.default_rng(9)
+    row = rng.standard_normal((256,)).astype(np.float32)
+    logits = jnp.asarray(np.stack([row, row]))   # identical distributions
+    temp = jnp.ones((2,), jnp.float32)
+    tk = jnp.zeros((2,), jnp.int32)
+    tp = jnp.ones((2,), jnp.float32)
+    seed = jnp.asarray([7, 7], jnp.int32)
+    pos = jnp.asarray([3, 3], jnp.int32)
+    ids_a, _ = sample_and_logprobs(
+        logits, row_sample_keys(jax.random.key(0), seed, pos), temp, tk, tp,
+        row_keys=True)
+    ids_b, _ = sample_and_logprobs(
+        logits, row_sample_keys(jax.random.key(99), seed, pos), temp, tk, tp,
+        row_keys=True)
+    np.testing.assert_array_equal(np.asarray(ids_a), np.asarray(ids_b))
+    # identical rows with identical seeds draw the same token
+    assert int(ids_a[0]) == int(ids_a[1])
 
 
 def test_token_logprobs_temperature_scaling():
