@@ -42,6 +42,14 @@ class ModelConfig:
     # MoE (mixtral-class). num_experts == 0 means dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # OPT-class decoder knobs (reference values-01-minimal-example.yaml:4-8
+    # serves facebook/opt-125m). Defaults describe the llama class.
+    norm_type: str = "rmsnorm"        # "rmsnorm" | "layernorm" (w/ bias)
+    pos_embedding: str = "rope"       # "rope" | "learned" (+2 OPT offset)
+    mlp_type: str = "swiglu"          # "swiglu" | "mlp" (fc1/act/fc2, biased)
+    mlp_act: str = "silu"             # "mlp" type only: "relu" | "gelu"
+    # OPT puts biases on the attention out-projection and the MLP.
+    linear_bias: bool = False
     # Serving dtype for weights/activations; fp32 accumulation on the MXU.
     dtype: str = "bfloat16"
     # Weight-only quantization of the big matmuls ("int8" or None): halves
@@ -86,6 +94,14 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         "debug-moe", vocab_size=512, hidden_size=128, intermediate_size=256,
         num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32, max_model_len=512,
         num_experts=4, num_experts_per_tok=2, dtype="float32",
+    ),
+    # The reference's minimal-example model (values-01-minimal-example.yaml:8).
+    "opt-125m": _p(
+        "opt-125m", vocab_size=50272, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, num_kv_heads=12, head_dim=64,
+        max_model_len=2048, tie_word_embeddings=True, attention_bias=True,
+        norm_type="layernorm", pos_embedding="learned", mlp_type="mlp",
+        mlp_act="relu", linear_bias=True,
     ),
     # BASELINE.json config 1.
     "tinyllama-1.1b": _p(
@@ -145,4 +161,8 @@ def get_model_config(name: str, **overrides) -> ModelConfig:
     for preset_key, cfg in MODEL_PRESETS.items():
         if preset_key.replace(".", "").replace("-", "") in base.replace(".", "").replace("-", ""):
             return cfg.replace(**overrides) if overrides else cfg
-    raise KeyError(f"unknown model {name!r}; known presets: {sorted(MODEL_PRESETS)}")
+    raise KeyError(
+        f"unknown model {name!r}; known presets: {sorted(MODEL_PRESETS)}. "
+        "To serve a model without a preset, pre-stage its HF checkpoint "
+        "locally and pass the absolute directory path (config.json supplies "
+        "the architecture; supported families: llama/qwen2/qwen3/mixtral/opt)")

@@ -76,6 +76,28 @@ class RequestOutput:
     output_logprobs: Optional[list[float]] = None  # full per-token record
 
 
+def _prefill_penalties(cfg, logits, int_t, prompt_lens, presence, frequency):
+    """Presence/frequency penalties at the PREFILL sampling point. A
+    recompute-preemption re-prefill carries the sequence's generated tokens
+    IN the batch (prompt + outputs re-prefilled together), so the output
+    histogram is built on-device from the batch itself: tokens at positions
+    >= the row's prompt_len are outputs. Fresh admissions have no output
+    tokens and penalize nothing. Gated by a runtime cond — penalty-free
+    batches (the common case) skip the [B, V] scatter."""
+    any_pen = jnp.any((presence != 0.0) | (frequency != 0.0))
+
+    def penalize(l):
+        tokens, seg_ids, positions = int_t[0], int_t[1], int_t[2]
+        row = jnp.clip(seg_ids, 0, l.shape[0] - 1)
+        out_mask = ((seg_ids >= 0)
+                    & (positions >= jnp.take(prompt_lens, row)))
+        counts = jnp.zeros((l.shape[0], cfg.vocab_size), jnp.int32)
+        counts = counts.at[row, tokens].add(out_mask.astype(jnp.int32))
+        return apply_penalties(l, counts, presence, frequency)
+
+    return jax.lax.cond(any_pen, penalize, lambda l: l, logits)
+
+
 def resolve_shardings(mesh, model_cfg):
     """(params_sharding, kv_sharding) for a serving mesh — the one place
     that picks between GSPMD Megatron layouts (parallel/sharding.py) and the
@@ -180,6 +202,13 @@ class LLMEngine:
         # Width of the host->device output-token resync buffer for the
         # penalty histogram (outputs are bounded by the model length).
         self._out_cap = config.effective_max_len
+        # Recycled device buffers for the sampled decode program, per padded
+        # batch size: counts cycle donated through windows and return to the
+        # pool when a chain drains (contents only read under rebuild/penalty
+        # conds, so staleness is harmless); the -1-filled out_tokens dummy is
+        # not donated and lives forever.
+        self._counts_pool: dict[int, Any] = {}
+        self._dummy_out: dict[int, Any] = {}
 
     def _resolve_use_pallas(self, use_pallas: Optional[bool]) -> bool:
         """Decide the kernel path ONCE, at init, from static facts — backend,
@@ -342,8 +371,9 @@ class LLMEngine:
         """Inputs arrive as TWO packed buffers (one int, one float) — each
         host->device upload is a round trip on remote-attached TPUs, so the
         step interface is packed tight: int_t [4, T] (tokens, seg_ids,
-        positions, slot_mapping), int_b [B, 2] (logits_indices, top_k),
-        float_b [B, 2] (temperature, top_p).
+        positions, slot_mapping), int_b [B, 4] (logits_indices, top_k, seed,
+        prompt_len), float_b [B, 4] (temperature, top_p, presence,
+        frequency).
 
         Under a pp mesh the same interface runs the circular pipeline of
         parallel/pp.py instead of the flat forward — the scheduler/step loop
@@ -396,8 +426,10 @@ class LLMEngine:
                 return model_lib.compute_logits(params, cfg, hidden), kv
 
         def prefill_step(params, kv: KVCache, int_t, int_b, float_b, key):
-            # int_b: [B, 3] = (logits_indices, top_k, seed)
+            # int_b: [B, 4] = (logits_indices, top_k, seed, prompt_len)
             logits, kv = fwd(params, kv, int_t, int_b[:, 0])
+            logits = _prefill_penalties(cfg, logits, int_t, int_b[:, 3],
+                                        float_b[:, 2], float_b[:, 3])
             pos_next = jnp.take(int_t[2], int_b[:, 0]) + 1
             keys = row_sample_keys(key, int_b[:, 2], pos_next)
             next_tokens, lps = sample_and_logprobs(
@@ -414,8 +446,12 @@ class LLMEngine:
         engines that never see a long prompt never pay for it. Gated by its
         own per-kernel flag (use_pallas_hist); GSPMD meshes route the kernel
         through the tp shard_map wrapper
-        (ops.attention.prefill_history_attention_tp), pp meshes keep XLA
-        (the pool's layer axis is pp-sharded)."""
+        (ops.attention.prefill_history_attention_tp). pp meshes run the
+        PIPELINED history path (parallel/pp._build_pp_hist_mapped): the
+        chunk is microbatched into sub-chunks with per-sub-chunk history
+        lengths, keeping the layer stack sharded — no all-gather of the
+        pp-sharded params (VERDICT r4 #6; previously this ran as plain GSPMD
+        and XLA gathered the whole stack per chunk)."""
         cfg = self.model_config
         use_pallas = self.use_pallas_hist
         # use_pallas_hist already encodes kernel eligibility (pp/sp
@@ -423,16 +459,51 @@ class LLMEngine:
         # other builders share.
         attn_mesh = self._gspmd_attn_mesh() if use_pallas else None
 
+        if self.pp_size > 1:
+            from ..parallel.pp import build_pp_mapped, pp_logits
+            S = self.pp_size
+            mapped = build_pp_mapped(self.mesh, cfg, "prefill_hist",
+                                     use_pallas=False)
+
+            def hist_fwd(params, kv, int_t, int_b, page_table, hist_len):
+                T = int_t.shape[1]
+                M = S if T % S == 0 else 1
+                sub = T // M
+                meta_mb = PrefillMeta(
+                    seg_ids=int_t[1].reshape(M, sub),
+                    positions=int_t[2].reshape(M, sub),
+                    slot_mapping=int_t[3].reshape(M, sub),
+                    logits_indices=jnp.zeros((M,) + int_b[:, 0].shape,
+                                             jnp.int32))
+                hist_lens = hist_len + jnp.arange(M, dtype=jnp.int32) * sub
+                h_mb, kvk, kvv = mapped(params, kv.k, kv.v,
+                                        int_t[0].reshape(M, sub), meta_mb,
+                                        page_table[0], hist_lens)
+                logits = pp_logits(params, cfg, h_mb.reshape(T, -1),
+                                   logits_indices=int_b[:, 0])
+                return logits, KVCache(k=kvk, v=kvv)
+        else:
+            def hist_fwd(params, kv, int_t, int_b, page_table, hist_len):
+                meta = PrefillMeta(seg_ids=int_t[1], positions=int_t[2],
+                                   slot_mapping=int_t[3],
+                                   logits_indices=int_b[:, 0])
+                hidden, kv, _ = model_lib.forward_prefill_hist(
+                    params, cfg, int_t[0], meta, kv, page_table[0], hist_len,
+                    use_pallas=use_pallas and attn_mesh is None,
+                    attn_mesh=attn_mesh)
+                return model_lib.compute_logits(params, cfg, hidden), kv
+
         def prefill_hist_step(params, kv: KVCache, int_t, int_b, float_b,
                               page_table, hist_len, key):
-            meta = PrefillMeta(seg_ids=int_t[1], positions=int_t[2],
-                               slot_mapping=int_t[3],
-                               logits_indices=int_b[:, 0])
-            hidden, kv = model_lib.forward_prefill_hist(
-                params, cfg, int_t[0], meta, kv, page_table[0], hist_len,
-                use_pallas=use_pallas and attn_mesh is None,
-                attn_mesh=attn_mesh)
-            logits = model_lib.compute_logits(params, cfg, hidden)
+            logits, kv = hist_fwd(params, kv, int_t, int_b, page_table,
+                                  hist_len)
+            # Best-effort penalties: counts cover THIS chunk's in-batch
+            # output tokens only (earlier chunks' token ids live in the KV
+            # pool as vectors, not ids). Re-prefill after preemption routes
+            # through the non-chunked program whenever the sequence fits the
+            # budget, so the common penalty path stays exact.
+            logits = _prefill_penalties(cfg, logits, int_t, int_b[:, 3],
+                                        float_b[:, 2], float_b[:, 3])
             pos_next = jnp.take(int_t[2], int_b[:, 0]) + 1
             keys = row_sample_keys(key, int_b[:, 2], pos_next)
             next_tokens, lps = sample_and_logprobs(
@@ -651,7 +722,8 @@ class LLMEngine:
                     [batch.tokens, batch.seg_ids, batch.positions,
                      batch.slot_mapping]))
                 int_b = jnp.asarray(np.stack(
-                    [batch.logits_indices, batch.top_k, batch.seed], axis=1))
+                    [batch.logits_indices, batch.top_k, batch.seed,
+                     batch.prompt_lens], axis=1))
                 if batch.hist_len is not None:
                     # Chunked prefill (solo): chunk attends to pool history.
                     self.stats.prefill_tokens += int(
@@ -691,6 +763,9 @@ class LLMEngine:
             successor["zombies"].update(
                 s.request_id for s in inflight["batch"].seqs if s.is_finished)
         else:
+            counts = inflight.get("counts")
+            if counts is not None:
+                self._counts_pool[counts.shape[0]] = counts
             self._drain_deferred()
         return outputs
 
@@ -714,8 +789,10 @@ class LLMEngine:
             any_pen = bool(np.any(batch.presence) or np.any(batch.frequency))
             rebuild = counts is None and any_pen
             if counts is None:
-                counts = jnp.zeros((B, self.model_config.vocab_size),
-                                   jnp.int32)
+                counts = self._counts_pool.pop(B, None)
+                if counts is None:
+                    counts = jnp.zeros((B, self.model_config.vocab_size),
+                                       jnp.int32)
             if rebuild:
                 # Fresh (non-chained) window with penalties active: re-sync
                 # the histogram from host-known output tokens. Chained
@@ -729,8 +806,11 @@ class LLMEngine:
                     ids = seq.output_token_ids[:self._out_cap]
                     out_tokens[s, :len(ids)] = ids
                 out_tokens = jnp.asarray(out_tokens)
+            elif B in self._dummy_out:
+                out_tokens = self._dummy_out[B]
             else:
-                out_tokens = jnp.full((B, self._out_cap), -1, jnp.int32)
+                out_tokens = self._dummy_out.setdefault(
+                    B, jnp.full((B, self._out_cap), -1, jnp.int32))
             dev_out, dev_lp, self.kv_cache, counts = self._decode_fn(
                 self.params, self.kv_cache, tokens_dev, int_b, float_b,
                 step_key, counts, out_tokens, jnp.asarray(rebuild))

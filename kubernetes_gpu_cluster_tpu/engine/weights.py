@@ -44,6 +44,9 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
     arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    if arch == "OPTForCausalLM":
+        return _opt_config_from_hf(hf, name or
+                                   os.path.basename(os.path.normpath(path)))
     num_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
     rope_scaling = None
@@ -74,6 +77,50 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
         num_experts=hf.get("num_local_experts", 0),
         num_experts_per_tok=hf.get("num_experts_per_tok", 2),
         max_model_len=min(int(hf.get("max_position_embeddings", 4096)), 8192),
+    )
+
+
+def _validate_act(act: str) -> str:
+    """Fail the LOAD on an unmapped activation, not the first trace."""
+    from ..models.llama import _MLP_ACTS
+    if act not in _MLP_ACTS:
+        raise ValueError(f"unsupported activation_function {act!r}; "
+                         f"supported: {sorted(_MLP_ACTS)}")
+    return act
+
+
+def _opt_config_from_hf(hf: dict, name: str) -> ModelConfig:
+    """OPT (the reference's minimal-example model, facebook/opt-125m at
+    reference values-01-minimal-example.yaml:8): learned positions (+2
+    offset), pre-LN LayerNorm with biases, biased ReLU fc1/fc2 MLP, tied
+    head. Served through the shared decoder graph (models/llama.py) via
+    ModelConfig flags."""
+    h = hf["hidden_size"]
+    num_heads = hf["num_attention_heads"]
+    if hf.get("word_embed_proj_dim", h) != h:
+        raise ValueError("OPT word_embed_proj_dim != hidden_size (projected "
+                         "embeddings) is not supported")
+    if not hf.get("do_layer_norm_before", True):
+        raise ValueError("OPT post-LN variants (do_layer_norm_before=false, "
+                         "e.g. opt-350m) are not supported")
+    bias = bool(hf.get("enable_bias", True))
+    return ModelConfig(
+        name=name,
+        vocab_size=hf["vocab_size"],
+        hidden_size=h,
+        intermediate_size=hf["ffn_dim"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=num_heads,
+        num_kv_heads=num_heads,
+        head_dim=h // num_heads,
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        attention_bias=bias,
+        norm_type="layernorm",
+        pos_embedding="learned",
+        mlp_type="mlp",
+        mlp_act=_validate_act(hf.get("activation_function", "relu")),
+        linear_bias=bias,
+        max_model_len=min(int(hf.get("max_position_embeddings", 2048)), 8192),
     )
 
 
@@ -418,6 +465,11 @@ def load_weights(path: str, cfg: ModelConfig,
     is built host-side and uploaded."""
     ckpt = _Checkpoint(path)
     dtype = dtype or cfg.jnp_dtype
+    if cfg.pos_embedding == "learned":
+        # OPT-class checkpoints (different HF tensor names, small models):
+        # full host load; sharded placement still works via device_put with
+        # the matching shardings pytree.
+        return _place(_load_opt_host(ckpt, cfg), cfg, dtype, shardings)
     if shardings is not None:
         return _load_streamed(ckpt, cfg, shardings, dtype)
     L = cfg.num_layers
@@ -490,24 +542,86 @@ def load_weights(path: str, cfg: ModelConfig,
         else:   # checkpoint ties even though config doesn't say so
             params["lm_head"] = np.ascontiguousarray(params["embed"].T)
 
+    return _place(params, cfg, dtype, None)
+
+
+def _place(params: Params, cfg: ModelConfig, dtype,
+           shardings: Optional[Any]) -> Params:
+    """Quantize (host-side, so the device never sees full-precision weights)
+    + dtype-convert + upload, optionally into a sharded placement."""
     if cfg.quantization:
-        # Host-side (numpy) so the device never sees the full-precision
-        # weights; the int8 tensors upload at half the bytes.
         from ..ops.quant import quantize_params
         params = quantize_params(params, cfg.quantization)
 
     def put(path_, x):
+        # Dtype conversion stays HOST-side (numpy + ml_dtypes): handing host
+        # arrays to device_put lets a sharded placement upload only each
+        # device's shard, instead of committing the full tensor to device 0
+        # first and resharding device-to-device.
         name = path_[-1].key if hasattr(path_[-1], "key") else str(path_[-1])
         if x.dtype == np.int8 or name.endswith("_scale"):
-            x = jnp.asarray(x)          # int8 weights / f32 scales as-is
-        else:
-            x = jnp.asarray(x, dtype=dtype)
-        return jax.device_put(x)
+            return np.ascontiguousarray(x)  # int8 weights / f32 scales as-is
+        return np.ascontiguousarray(np.asarray(x, dtype=dtype))
 
-    out = jax.tree_util.tree_map_with_path(put, params)
+    params = jax.tree_util.tree_map_with_path(put, params)
+    out = (jax.device_put(params, shardings) if shardings is not None
+           else jax.tree.map(jax.device_put, params))
     n_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(out))
     logger.info("loaded %s: %.2f GB as %s", cfg.name, n_bytes / 1e9, dtype)
     return out
+
+
+def _load_opt_host(ckpt: _Checkpoint, cfg: ModelConfig) -> Params:
+    """OPT HF checkpoint -> shared-decoder pytree (host numpy). Tensor names
+    per HF OPTForCausalLM: note the per-layer PRE-MLP norm is called
+    ``final_layer_norm`` inside each layer, distinct from the decoder-level
+    ``model.decoder.final_layer_norm``."""
+    L = cfg.num_layers
+    pre = "model.decoder.layers.{}."
+
+    def stack(suffix, transpose=True):
+        first = (ckpt.get_t if transpose else ckpt.get)(pre.format(0) + suffix)
+        out = np.empty((L,) + first.shape, first.dtype)
+        out[0] = first
+        for l in range(1, L):
+            out[l] = (ckpt.get_t if transpose
+                      else ckpt.get)(pre.format(l) + suffix)
+        return out
+
+    layers: Params = {
+        "input_norm": stack("self_attn_layer_norm.weight", transpose=False),
+        "input_norm_b": stack("self_attn_layer_norm.bias", transpose=False),
+        "post_attn_norm": stack("final_layer_norm.weight", transpose=False),
+        "post_attn_norm_b": stack("final_layer_norm.bias", transpose=False),
+        "wq": stack("self_attn.q_proj.weight"),
+        "wk": stack("self_attn.k_proj.weight"),
+        "wv": stack("self_attn.v_proj.weight"),
+        "wo": stack("self_attn.out_proj.weight"),
+        "w_up": stack("fc1.weight"),
+        "w_down": stack("fc2.weight"),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = stack("self_attn.q_proj.bias", transpose=False)
+        layers["bk"] = stack("self_attn.k_proj.bias", transpose=False)
+        layers["bv"] = stack("self_attn.v_proj.bias", transpose=False)
+    if cfg.linear_bias:
+        layers["bo"] = stack("self_attn.out_proj.bias", transpose=False)
+        layers["b_up"] = stack("fc1.bias", transpose=False)
+        layers["b_down"] = stack("fc2.bias", transpose=False)
+
+    params: Params = {
+        "embed": ckpt.get("model.decoder.embed_tokens.weight"),
+        "pos_embed": ckpt.get("model.decoder.embed_positions.weight"),
+        "final_norm": ckpt.get("model.decoder.final_layer_norm.weight"),
+        "final_norm_b": ckpt.get("model.decoder.final_layer_norm.bias"),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        if "lm_head.weight" in ckpt:
+            params["lm_head"] = ckpt.get_t("lm_head.weight")
+        else:
+            params["lm_head"] = np.ascontiguousarray(params["embed"].T)
+    return params
 
 
 def resolve_model(model_url: str, name: Optional[str] = None):

@@ -23,6 +23,7 @@ TPU-first design decisions:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, NamedTuple, Optional
 
 import jax
@@ -104,18 +105,36 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Optional[jnp.dtype] = N
         layers["w_up"] = w(next(keys), (L, E, d, ff), d)
         layers["w_down"] = w(next(keys), (L, E, ff, d), ff)
     else:
-        layers["w_gate"] = w(next(keys), (L, d, ff), d)
+        if cfg.mlp_type != "mlp":
+            layers["w_gate"] = w(next(keys), (L, d, ff), d)
         layers["w_up"] = w(next(keys), (L, d, ff), d)
         layers["w_down"] = w(next(keys), (L, ff, d), ff)
+    _add_opt_extras(cfg, layers, dtype)
 
     params: Params = {
         "embed": w(next(keys), (cfg.vocab_size, d), d),
         "final_norm": jnp.ones((d,), dtype),
         "layers": layers,
     }
+    if cfg.norm_type == "layernorm":
+        params["final_norm_b"] = jnp.zeros((d,), dtype)
+    if cfg.pos_embedding == "learned":
+        params["pos_embed"] = w(next(keys), (cfg.max_model_len + 2, d), d)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = w(next(keys), (d, cfg.vocab_size), d)
     return params
+
+
+def _add_opt_extras(cfg: ModelConfig, layers: Params, dtype) -> None:
+    """Per-layer OPT-class extras: LayerNorm biases and linear biases."""
+    d, L, ff = cfg.hidden_size, cfg.num_layers, cfg.intermediate_size
+    if cfg.norm_type == "layernorm":
+        layers["input_norm_b"] = jnp.zeros((L, d), dtype)
+        layers["post_attn_norm_b"] = jnp.zeros((L, d), dtype)
+    if cfg.linear_bias:
+        layers["bo"] = jnp.zeros((L, d), dtype)
+        layers["b_up"] = jnp.zeros((L, ff), dtype)
+        layers["b_down"] = jnp.zeros((L, d), dtype)
 
 
 def _init_params_int8(cfg: ModelConfig, key: jax.Array, dtype, w) -> Params:
@@ -156,19 +175,26 @@ def _init_params_int8(cfg: ModelConfig, key: jax.Array, dtype, w) -> Params:
     if cfg.qk_norm:
         layers["q_norm"] = jnp.ones((L, hd), dtype)
         layers["k_norm"] = jnp.ones((L, hd), dtype)
-    mlp_shapes = (("w_gate", (L, E, d, ff) if cfg.is_moe else (L, d, ff), d),
+    mlp_shapes = [("w_gate", (L, E, d, ff) if cfg.is_moe else (L, d, ff), d),
                   ("w_up", (L, E, d, ff) if cfg.is_moe else (L, d, ff), d),
-                  ("w_down", (L, E, ff, d) if cfg.is_moe else (L, ff, d), ff))
+                  ("w_down", (L, E, ff, d) if cfg.is_moe else (L, ff, d), ff)]
+    if not cfg.is_moe and cfg.mlp_type == "mlp":
+        mlp_shapes = mlp_shapes[1:]
     if cfg.is_moe:
         layers["router"] = w(next(keys), (L, d, E), d)
     for name, shape, fan in mlp_shapes:
         layers[name], layers[name + "_scale"] = wq8(next(keys), shape, fan)
+    _add_opt_extras(cfg, layers, dtype)
 
     params: Params = {
         "embed": w(next(keys), (cfg.vocab_size, d), d),
         "final_norm": jnp.ones((d,), dtype),
         "layers": layers,
     }
+    if cfg.norm_type == "layernorm":
+        params["final_norm_b"] = jnp.zeros((d,), dtype)
+    if cfg.pos_embedding == "learned":
+        params["pos_embed"] = w(next(keys), (cfg.max_model_len + 2, d), d)
     if not cfg.tie_word_embeddings:
         params["lm_head"], params["lm_head_scale"] = wq8(
             next(keys), (d, cfg.vocab_size), d)
@@ -185,6 +211,36 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
 
 
+def layer_norm(x: jax.Array, weight: jax.Array, bias: jax.Array,
+               eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mu) * (xf - mu), axis=-1, keepdims=True)
+    y = ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    return y * weight + bias
+
+
+def _norm(cfg: ModelConfig, x: jax.Array, store: Params,
+          name: str) -> jax.Array:
+    """Config-dispatched normalization: llama-class RMSNorm or OPT-class
+    LayerNorm (with bias, stored as ``<name>_b``). norm_type is static
+    config, so the branch resolves at trace time."""
+    if cfg.norm_type == "layernorm":
+        return layer_norm(x, store[name], store[name + "_b"],
+                          cfg.rms_norm_eps)
+    return rms_norm(x, store[name], cfg.rms_norm_eps)
+
+
+def _embed(params: Params, cfg: ModelConfig, tokens: jax.Array,
+           positions: jax.Array) -> jax.Array:
+    """Token embedding lookup, plus OPT-class learned positional embeddings
+    (HF OPTLearnedPositionalEmbedding keeps a +2 offset into the table)."""
+    h = params["embed"][tokens]
+    if cfg.pos_embedding == "learned":
+        h = h + params["pos_embed"][positions + 2]
+    return h
+
+
 def _dot(x: jax.Array, lp: Params, name: str) -> jax.Array:
     """x @ lp[name] in f32, transparently handling int8 weights: the int8->
     bf16 convert fuses into the dot (weights stream from HBM at half the
@@ -197,10 +253,34 @@ def _dot(x: jax.Array, lp: Params, name: str) -> jax.Array:
     return jnp.dot(x, w, preferred_element_type=jnp.float32)
 
 
-def _dense_mlp(lp: Params, x: jax.Array, tp_axis: Optional[str] = None) -> jax.Array:
+# HF ACT2FN["gelu"] is the exact erf GELU; jax.nn.gelu defaults to the tanh
+# approximation, which accumulates ~1e-3 activation error per layer and
+# breaks HF-parity tolerances.
+_MLP_ACTS = {"relu": jax.nn.relu,
+             "gelu": functools.partial(jax.nn.gelu, approximate=False),
+             "gelu_new": jax.nn.gelu,   # HF's tanh-approximated variant
+             "silu": jax.nn.silu}
+
+
+def _dense_mlp(lp: Params, x: jax.Array, cfg: ModelConfig,
+               tp_axis: Optional[str] = None) -> jax.Array:
     """Megatron MLP: gate/up column-sharded, down row-sharded. Under GSPMD
     (tp_axis=None) the psum is inserted by the partitioner; inside shard_map
-    (parallel/pp.py) ``tp_axis`` names the manual mesh axis to reduce over."""
+    (parallel/pp.py) ``tp_axis`` names the manual mesh axis to reduce over.
+    ``mlp_type="mlp"`` is the OPT-class fc1/act/fc2 block (w_up/w_down with
+    biases, no gate); biases add AFTER the down-projection reduce so they
+    are applied exactly once under tp."""
+    if cfg.mlp_type == "mlp":
+        h = _dot(x, lp, "w_up")
+        if "b_up" in lp:
+            h = h + lp["b_up"]
+        h = _MLP_ACTS[cfg.mlp_act](h).astype(x.dtype)
+        out = _dot(h, lp, "w_down")
+        if tp_axis is not None:
+            out = jax.lax.psum(out, tp_axis)
+        if "b_down" in lp:
+            out = out + lp["b_down"]
+        return out.astype(x.dtype)
     gate = _dot(x, lp, "w_gate")
     up = _dot(x, lp, "w_up")
     h = (jax.nn.silu(gate) * up).astype(x.dtype)
@@ -270,10 +350,11 @@ def _qkv(lp: Params, cfg: ModelConfig, x: jax.Array, positions: jax.Array):
     if cfg.qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
-                            scaling=cfg.rope_scaling_dict)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    if cfg.pos_embedding == "rope":
+        cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta,
+                                scaling=cfg.rope_scaling_dict)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
     return q, k, v
 
 
@@ -282,7 +363,7 @@ def _mlp_block(lp: Params, cfg: ModelConfig, x: jax.Array,
                ep_axis: Optional[str] = None) -> jax.Array:
     if cfg.is_moe:
         return _moe_mlp(lp, x, cfg, tp_axis=tp_axis, ep_axis=ep_axis)
-    return _dense_mlp(lp, x, tp_axis=tp_axis)
+    return _dense_mlp(lp, x, cfg, tp_axis=tp_axis)
 
 
 # ---------------------------------------------------------------------------
@@ -328,16 +409,18 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array,
     def body(h, xs):
         lp, layer_idx = xs
         resid = h
-        x = rms_norm(h, lp["input_norm"], cfg.rms_norm_eps)
+        x = _norm(cfg, h, lp, "input_norm")
         q, k, v = _qkv(lp, cfg, x, positions)
         attn_out = attn_fn(lp, q, k, v, layer_idx)
         attn_out = attn_out.reshape(x.shape[0], -1)
         o = _dot(attn_out, lp, "wo")
         if tp_axis is not None:  # row-sharded wo: partial sums over local heads
             o = jax.lax.psum(o, tp_axis)
+        if "bo" in lp:           # after the reduce: applied exactly once
+            o = o + lp["bo"]
         h = resid + o.astype(h.dtype)
         resid = h
-        x = rms_norm(h, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = _norm(cfg, h, lp, "post_attn_norm")
         h = resid + _mlp_block(lp, cfg, x, tp_axis=tp_axis, ep_axis=ep_axis)
         return h, (k, v)
 
@@ -362,7 +445,8 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
     ``attn_impl``: full override ``fn(q, k, v, seg_ids, positions) -> out``
     (the engine passes ring attention here for sp>1 meshes)."""
     scale = cfg.head_dim ** -0.5
-    h = params["embed"][tokens] if hidden_in is None else hidden_in
+    h = (_embed(params, cfg, tokens, meta.positions)
+         if hidden_in is None else hidden_in)
 
     def attn_fn(lp, q, k, v, layer_idx):
         # Prefill attends within the in-batch k/v only (each sequence's whole
@@ -384,19 +468,25 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
     new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
                                          meta.slot_mapping))
     selected = h[meta.logits_indices]
-    return rms_norm(selected, params["final_norm"], cfg.rms_norm_eps), new_kv, h
+    return _norm(cfg, selected, params, "final_norm"), new_kv, h
 
 
 def forward_prefill_hist(params: Params, cfg: ModelConfig, tokens: jax.Array,
                          meta: PrefillMeta, kv: KVCache,
                          page_table: jax.Array, hist_len: jax.Array,
-                         use_pallas=None, attn_mesh=None):
+                         use_pallas=None, attn_mesh=None,
+                         hidden_in: Optional[jax.Array] = None,
+                         tp_axis: Optional[str] = None,
+                         ep_axis: Optional[str] = None):
     """Chunked prefill: one sequence's chunk attending to its pool history +
     itself causally (ops.attention.prefill_history_attention). Returns
-    (normed_selected [1, d], new_kv). ``attn_mesh``: under a GSPMD mesh, run
-    the Pallas history kernel per-shard via shard_map over the tp axis."""
+    (normed_selected [1, d], new_kv, raw_hidden [T, d]). ``attn_mesh``: under
+    a GSPMD mesh, run the Pallas history kernel per-shard via shard_map over
+    the tp axis. ``hidden_in``/``tp_axis``/``ep_axis``: manual-mesh entry for
+    non-first pipeline stages (parallel/pp.py's pipelined chunked prefill)."""
     scale = cfg.head_dim ** -0.5
-    h = params["embed"][tokens]
+    h = (_embed(params, cfg, tokens, meta.positions)
+         if hidden_in is None else hidden_in)
 
     def attn_fn(lp, q, k, v, layer_idx):
         if attn_mesh is not None:
@@ -408,11 +498,12 @@ def forward_prefill_hist(params: Params, cfg: ModelConfig, tokens: jax.Array,
             page_table, hist_len, scale, layer=layer_idx,
             use_pallas=use_pallas)
 
-    h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn)
+    h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn,
+                                  tp_axis=tp_axis, ep_axis=ep_axis)
     new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
                                          meta.slot_mapping))
     selected = h[meta.logits_indices]
-    return rms_norm(selected, params["final_norm"], cfg.rms_norm_eps), new_kv
+    return _norm(cfg, selected, params, "final_norm"), new_kv, h
 
 
 def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
@@ -427,7 +518,8 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
     ``attn_mesh``: under a GSPMD mesh, run the Pallas attention per-shard via
     shard_map over the tp axis (ops.attention.paged_decode_attention_tp)."""
     scale = cfg.head_dim ** -0.5
-    h = params["embed"][tokens] if hidden_in is None else hidden_in
+    h = (_embed(params, cfg, tokens, meta.positions)
+         if hidden_in is None else hidden_in)
 
     if layer_slice is not None:
         kv = KVCache(k=kv.k[layer_slice[0]:layer_slice[1]],
@@ -451,7 +543,7 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
                                   layer_slice, tp_axis=tp_axis, ep_axis=ep_axis)
     new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
                                          meta.slot_mapping))
-    return rms_norm(h, params["final_norm"], cfg.rms_norm_eps), new_kv, h
+    return _norm(cfg, h, params, "final_norm"), new_kv, h
 
 
 def compute_logits(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:

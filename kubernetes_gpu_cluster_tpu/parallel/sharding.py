@@ -73,13 +73,21 @@ def param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict[str, Any]:
     if cfg.qk_norm:
         layers["q_norm"] = _ns(mesh)
         layers["k_norm"] = _ns(mesh)
+    if cfg.norm_type == "layernorm":      # OPT-class LayerNorm biases
+        layers["input_norm_b"] = _ns(mesh)
+        layers["post_attn_norm_b"] = _ns(mesh)
+    if cfg.linear_bias:                   # OPT-class out/MLP biases
+        layers["bo"] = _ns(mesh)
+        layers["b_up"] = _ns(mesh, None, "tp")   # follows w_up's out axis
+        layers["b_down"] = _ns(mesh)
     if cfg.is_moe:
         layers["router"] = _ns(mesh)
         layers["w_gate"] = _ns(mesh, None, "ep", None, "tp")
         layers["w_up"] = _ns(mesh, None, "ep", None, "tp")
         layers["w_down"] = _ns(mesh, None, "ep", "tp", None)
     else:
-        layers["w_gate"] = _ns(mesh, None, None, "tp")
+        if cfg.mlp_type != "mlp":
+            layers["w_gate"] = _ns(mesh, None, None, "tp")
         layers["w_up"] = _ns(mesh, None, None, "tp")
         layers["w_down"] = _ns(mesh, None, "tp", None)
 
@@ -88,6 +96,10 @@ def param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict[str, Any]:
         "final_norm": _ns(mesh),
         "layers": layers,
     }
+    if cfg.norm_type == "layernorm":
+        shardings["final_norm_b"] = _ns(mesh)
+    if cfg.pos_embedding == "learned":
+        shardings["pos_embed"] = _ns(mesh)
     if not cfg.tie_word_embeddings:
         shardings["lm_head"] = _ns(mesh, None, "tp")
     if cfg.quantization:
@@ -103,7 +115,8 @@ def param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict[str, Any]:
             layers["w_up_scale"] = _ns(mesh, None, "ep", "tp")
             layers["w_down_scale"] = _ns(mesh, None, "ep", None)
         else:
-            layers["w_gate_scale"] = _ns(mesh, None, "tp")
+            if cfg.mlp_type != "mlp":
+                layers["w_gate_scale"] = _ns(mesh, None, "tp")
             layers["w_up_scale"] = _ns(mesh, None, "tp")
             layers["w_down_scale"] = _ns(mesh)
         if not cfg.tie_word_embeddings:

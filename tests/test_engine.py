@@ -115,6 +115,27 @@ def test_frequency_penalty_suppresses_repeats():
         or base.output_token_ids == pen.output_token_ids
 
 
+def test_preempted_seeded_penalized_output_unchanged():
+    """Recompute-preemption must not change seeded+penalized results: the
+    re-prefill's sampling point applies the same output-token penalties
+    (built on-device from the re-prefilled batch) and the same seeded keys
+    as the uninterrupted run (regression: penalties were skipped at the
+    prefill sampling point)."""
+    prompts = [[9, 8, 7, 6], [1, 2, 3, 4], [5, 5, 5, 5]]
+    params = [SamplingParams(max_tokens=16, temperature=0.8, seed=11,
+                             frequency_penalty=1.5, presence_penalty=0.5),
+              SamplingParams(max_tokens=16, temperature=0.8, seed=22,
+                             frequency_penalty=1.5),
+              SamplingParams(max_tokens=16, temperature=0.0)]
+    big = make_engine(num_pages=128, max_seqs=4)
+    small = make_engine(num_pages=8, max_seqs=4)
+    outs_big = big.generate(prompts, params)
+    outs_small = small.generate(prompts, params)
+    assert small.scheduler.num_preemptions > 0
+    for a, b in zip(outs_big, outs_small):
+        assert a.output_token_ids == b.output_token_ids
+
+
 def test_penalty_params_validated():
     with pytest.raises(ValueError):
         SamplingParams(presence_penalty=3.0)

@@ -352,3 +352,36 @@ def test_north_star_70b_tp_pp_traces():
     fn = build_pp_forward(mesh, cfg, "decode", use_pallas=False)
     out_shape, kv_shape_out = jax.eval_shape(fn, p_shapes, kv, tokens, meta)
     assert out_shape.shape == (M, B, cfg.hidden_size)
+
+
+def test_pp_hist_no_layer_stack_gather():
+    """The pipelined chunked-prefill program must keep the layer stack
+    pp-sharded: its compiled HLO contains NO all-gather reassembling a full
+    stacked weight (VERDICT r4 #6 — the old GSPMD path gathered the stack on
+    every long-prompt chunk)."""
+    from kubernetes_gpu_cluster_tpu.models.llama import PrefillMeta
+    from kubernetes_gpu_cluster_tpu.parallel.pp import (
+        build_pp_mapped, pp_kv_sharding, pp_param_shardings)
+
+    cfg = get_model_config("debug-tiny")
+    mesh = make_mesh(pp=2)
+    mapped = build_pp_mapped(mesh, cfg, "prefill_hist", use_pallas=False)
+    params = jax.device_put(model_lib.init_params(cfg, jax.random.key(0)),
+                            pp_param_shardings(mesh, cfg))
+    kv = allocate_kv_cache(cfg, CacheConfig(page_size=8, num_pages=16), 16,
+                           pp_kv_sharding(mesh))
+    M, sub = 2, 8
+    meta_mb = PrefillMeta(
+        seg_ids=jnp.zeros((M, sub), jnp.int32),
+        positions=jnp.tile(jnp.arange(sub, dtype=jnp.int32), (M, 1)),
+        slot_mapping=jnp.zeros((M, sub), jnp.int32),
+        logits_indices=jnp.zeros((M, 1), jnp.int32))
+    f = jax.jit(mapped)
+    txt = f.lower(params, kv.k, kv.v, jnp.zeros((M, sub), jnp.int32),
+                  meta_mb, jnp.zeros((4,), jnp.int32),
+                  jnp.zeros((M,), jnp.int32)).compile().as_text()
+    L, d = cfg.num_layers, cfg.hidden_size
+    stacked_marker = f"[{L},{d},"   # any full [L, d, *] weight reassembly
+    offending = [ln for ln in txt.splitlines()
+                 if "all-gather" in ln and stacked_marker in ln]
+    assert not offending, offending[:3]

@@ -123,3 +123,35 @@ def test_quantized_pp_specs_cover_scales():
         flat_s = {jax.tree_util.keystr(k) for k, _ in
                   jax.tree_util.tree_leaves_with_path(specs)}
         assert flat_p == flat_s, (model, flat_p ^ flat_s)
+
+
+def test_opt_class_int8_specs_and_engine():
+    """OPT-class flags (layernorm/learned-pos/biased-relu MLP) + int8: the
+    spec pytrees must match the quantized params pytree (no w_gate, biased
+    extras present), and the engine serves the quantized model."""
+    from kubernetes_gpu_cluster_tpu.parallel import make_mesh, param_shardings
+    from kubernetes_gpu_cluster_tpu.parallel.pp import param_pp_specs
+
+    cfg = get_model_config(
+        "debug-tiny", norm_type="layernorm", pos_embedding="learned",
+        mlp_type="mlp", mlp_act="relu", linear_bias=True,
+        attention_bias=True).replace(quantization="int8")
+    params = model_lib.init_params(cfg, jax.random.key(0))
+    assert "w_gate" not in params["layers"]
+    assert "pos_embed" in params and "final_norm_b" in params
+
+    flat_p = {jax.tree_util.keystr(k) for k, _ in
+              jax.tree_util.tree_leaves_with_path(params)}
+    for specs in (param_shardings(make_mesh(tp=2), cfg), param_pp_specs(cfg)):
+        flat_s = {jax.tree_util.keystr(k) for k, _ in
+                  jax.tree_util.tree_leaves_with_path(specs)}
+        assert flat_p == flat_s, flat_p ^ flat_s
+
+    eng = LLMEngine(EngineConfig(
+        model=cfg, cache=CacheConfig(page_size=8, num_pages=32),
+        scheduler=SchedulerConfig(max_num_seqs=2, max_prefill_tokens=64,
+                                  decode_buckets=(1, 2),
+                                  prefill_buckets=(32, 64), decode_window=2)))
+    out = eng.generate([[1, 2, 3]], SamplingParams(max_tokens=4,
+                                                   temperature=0.0))[0]
+    assert len(out.output_token_ids) == 4
