@@ -313,6 +313,8 @@ def _validate_model_url(spec: dict) -> None:
         raise ValueError(f"modelSpec '{name}': missing modelURL")
     if os.path.isabs(url):
         return
+    if os.path.isdir(url) and os.path.exists(os.path.join(url, "config.json")):
+        return   # relative local checkpoint dir: serves real weights
     from ..config.model_config import get_model_config
     try:
         get_model_config(url)
@@ -333,8 +335,8 @@ def _validate_model_url(spec: dict) -> None:
             "(mounted via extraVolumes). As rendered, the server will exit "
             "at start with this guidance.", name, url)
         return
-    # A hub-id modelURL NEVER loads real weights — mounted volumes are only
-    # consulted for absolute-path modelURLs — so warn unconditionally.
+    # A hub-id modelURL (not a local checkpoint dir) never loads real
+    # weights, regardless of mounted volumes — warn unconditionally.
     logger.warning(
         "modelSpec '%s': modelURL %r is a hub id — the pod will serve "
         "RANDOM-INIT weights (smoke/bench mode). For real serving, "

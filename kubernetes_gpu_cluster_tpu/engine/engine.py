@@ -494,16 +494,22 @@ class LLMEngine:
                 return model_lib.compute_logits(params, cfg, hidden), kv
 
         def prefill_hist_step(params, kv: KVCache, int_t, int_b, float_b,
-                              page_table, hist_len, key):
+                              page_table, hist_len, out_tokens, key):
             logits, kv = hist_fwd(params, kv, int_t, int_b, page_table,
                                   hist_len)
-            # Best-effort penalties: counts cover THIS chunk's in-batch
-            # output tokens only (earlier chunks' token ids live in the KV
-            # pool as vectors, not ids). Re-prefill after preemption routes
-            # through the non-chunked program whenever the sequence fits the
-            # budget, so the common penalty path stays exact.
-            logits = _prefill_penalties(cfg, logits, int_t, int_b[:, 3],
-                                        float_b[:, 2], float_b[:, 3])
+            # EXACT penalties on the chunked path: earlier chunks' token ids
+            # live in the pool as vectors, not ids, so the histogram comes
+            # from a HOST resync (out_tokens [B, cap], -1-padded — the host
+            # always knows the full output history) instead of the in-batch
+            # count the non-chunked program uses. Gated: penalty-free
+            # batches upload a cached dummy and skip the scatter.
+            presence, frequency = float_b[:, 2], float_b[:, 3]
+            logits = jax.lax.cond(
+                jnp.any((presence != 0.0) | (frequency != 0.0)),
+                lambda l: apply_penalties(
+                    l, build_counts(out_tokens, cfg.vocab_size),
+                    presence, frequency),
+                lambda l: l, logits)
             pos_next = jnp.take(int_t[2], int_b[:, 0]) + 1
             keys = row_sample_keys(key, int_b[:, 2], pos_next)
             next_tokens, lps = sample_and_logprobs(
@@ -731,7 +737,8 @@ class LLMEngine:
                     next_tokens, lps, self.kv_cache = self._prefill_hist_fn(
                         self.params, self.kv_cache, int_t, int_b, float_b,
                         jnp.asarray(batch.page_tables),
-                        jnp.int32(batch.hist_len), step_key)
+                        jnp.int32(batch.hist_len),
+                        self._penalty_out_tokens(batch), step_key)
                     if batch.partial:
                         # Prompt not complete: KV is committed, the sampled
                         # token is meaningless — nothing to report yet.
@@ -769,6 +776,22 @@ class LLMEngine:
             self._drain_deferred()
         return outputs
 
+    def _penalty_out_tokens(self, batch: ScheduledBatch):
+        """[B, out_cap] -1-padded output-token ids for the device-side
+        penalty histogram resync; the cached -1 dummy when no request in the
+        batch has penalties (the program's cond never reads it then)."""
+        B = len(batch.temperature)
+        if not (np.any(batch.presence) or np.any(batch.frequency)):
+            if B not in self._dummy_out:
+                self._dummy_out[B] = jnp.full((B, self._out_cap), -1,
+                                              jnp.int32)
+            return self._dummy_out[B]
+        out = np.full((B, self._out_cap), -1, np.int32)
+        for s, seq in enumerate(batch.seqs):
+            ids = seq.output_token_ids[:self._out_cap]
+            out[s, :len(ids)] = ids
+        return jnp.asarray(out)
+
     def _dispatch_window(self, batch: ScheduledBatch, tokens_dev,
                          positions: np.ndarray, float_b,
                          counts=None) -> dict:
@@ -801,11 +824,7 @@ class LLMEngine:
                 # penalty-free sampled batches (the common case) skip the
                 # host assembly + upload + scatter entirely — counts stay a
                 # device zero-fill that apply_penalties never reads.
-                out_tokens = np.full((B, self._out_cap), -1, np.int32)
-                for s, seq in enumerate(batch.seqs):
-                    ids = seq.output_token_ids[:self._out_cap]
-                    out_tokens[s, :len(ids)] = ids
-                out_tokens = jnp.asarray(out_tokens)
+                out_tokens = self._penalty_out_tokens(batch)
             elif B in self._dummy_out:
                 out_tokens = self._dummy_out[B]
             else:

@@ -19,6 +19,7 @@ that select the Pallas TPU kernels from ``ops.pallas`` when running on TPU.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -81,8 +82,30 @@ def write_kv_pages_all(kv_k: jax.Array, kv_v: jax.Array,
             return fk, fv
         fk, fv = jax.lax.fori_loop(0, T, body, (fk, fv))
     else:
-        fk = fk.at[:, slot_mapping].set(k_rows)
-        fv = fv.at[:, slot_mapping].set(v_rows)
+        # Without a layout pin, XLA transposes the WHOLE pool to its
+        # preferred scatter layout and back ({3,2,1,0}->{3,0,2,1}->...): 4
+        # pool-sized copies per prefill flush (~4.4 GB HBM traffic on the 1B
+        # pool). Pinning operands+results to the donated buffer's default
+        # layout removes ALL pool copies on the 1B config (compile-verified,
+        # interleaved A/B r5: prefill no worse / slightly better, decode
+        # within drift). On the 8B W=48 geometry the scatter's preference
+        # survives as one pre-copy, so that geometry stays HBM-bound —
+        # W=32/budget-2048 remains the 8B fit. KGCT_POOL_LAYOUT_PIN=0
+        # reverts.
+        if os.environ.get("KGCT_POOL_LAYOUT_PIN", "1") != "0" \
+                and jax.default_backend() == "tpu" \
+                and jax.device_count() == 1:
+            # Single-chip only: under meshes GSPMD owns placement (per-shard
+            # copies are proportionally smaller there anyway).
+            from jax.experimental.layout import Layout, with_layout_constraint
+            fmt = Layout((0, 1, 2))
+            fk, fv = with_layout_constraint((fk, fv), (fmt, fmt))
+            fk = fk.at[:, slot_mapping].set(k_rows)
+            fv = fv.at[:, slot_mapping].set(v_rows)
+            fk, fv = with_layout_constraint((fk, fv), (fmt, fmt))
+        else:
+            fk = fk.at[:, slot_mapping].set(k_rows)
+            fv = fv.at[:, slot_mapping].set(v_rows)
     return fk.reshape(kv_k.shape), fv.reshape(kv_v.shape)
 
 

@@ -136,6 +136,38 @@ def test_preempted_seeded_penalized_output_unchanged():
         assert a.output_token_ids == b.output_token_ids
 
 
+def test_preempted_penalized_chunked_reprefill_exact():
+    """When a preempted penalized+seeded sequence's prompt+outputs exceed
+    the prefill budget, the re-prefill takes the CHUNKED path — whose
+    penalty histogram comes from a host resync of the full output history,
+    so outputs must still match the unpressured run exactly (regression:
+    the chunked path used to count only the final chunk's in-batch
+    tokens)."""
+    from kubernetes_gpu_cluster_tpu.config import (
+        CacheConfig, EngineConfig, SchedulerConfig, get_model_config)
+
+    def engine(num_pages):
+        return LLMEngine(EngineConfig(
+            model=get_model_config("debug-tiny"),
+            cache=CacheConfig(page_size=8, num_pages=num_pages),
+            scheduler=SchedulerConfig(
+                max_num_seqs=4, max_prefill_tokens=16,
+                decode_buckets=(1, 2, 4), prefill_buckets=(16,))))
+
+    prompts = [[9, 8, 7, 6], [1, 2, 3, 4], [5, 5, 5, 5]]
+    params = [SamplingParams(max_tokens=20, temperature=0.8, seed=11,
+                             frequency_penalty=1.5, presence_penalty=0.5),
+              SamplingParams(max_tokens=20, temperature=0.8, seed=22,
+                             frequency_penalty=1.5),
+              SamplingParams(max_tokens=20, temperature=0.0)]
+    big, small = engine(128), engine(9)
+    outs_big = big.generate(prompts, params)
+    outs_small = small.generate(prompts, params)
+    assert small.scheduler.num_preemptions > 0
+    for a, b in zip(outs_big, outs_small):
+        assert a.output_token_ids == b.output_token_ids
+
+
 def test_penalty_params_validated():
     with pytest.raises(ValueError):
         SamplingParams(presence_penalty=3.0)
