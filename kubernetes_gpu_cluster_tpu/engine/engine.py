@@ -31,9 +31,23 @@ import numpy as np
 from ..config import EngineConfig
 from ..models import llama as model_lib
 from ..models.llama import DecodeMeta, PrefillMeta
-from ..ops.sampling import (apply_penalties, build_counts, bump_counts,
-                            row_sample_keys, sample_and_logprobs,
-                            token_logprobs)
+from ..ops.sampling import (apply_logit_bias, apply_penalties, build_counts,
+                            bump_counts, row_sample_keys,
+                            sample_and_logprobs, token_logprobs)
+
+# OpenAI's logit_bias cap; the device-side sparse-bias buffers are padded to
+# this width (uploaded only when a batch actually carries biases).
+LOGIT_BIAS_CAP = 300
+
+
+def _maybe_bias(logits, bias_ids, bias_vals):
+    """Sparse additive logit_bias under a runtime cond (bias-free batches —
+    the common case — skip the scatter; they pass a cached -1 dummy).
+    Applied BEFORE penalties/temperature (OpenAI: 'prior to sampling')."""
+    return jax.lax.cond(
+        jnp.any(bias_ids >= 0),
+        lambda l: apply_logit_bias(l, bias_ids, bias_vals),
+        lambda l: l, logits)
 from ..utils import cdiv, get_logger
 from .kv_cache import KVCache, allocate_kv_cache, derive_num_pages
 from .sampling_params import SamplingParams
@@ -209,6 +223,7 @@ class LLMEngine:
         # not donated and lives forever.
         self._counts_pool: dict[int, Any] = {}
         self._dummy_out: dict[int, Any] = {}
+        self._dummy_bias: dict[int, Any] = {}
 
     def _resolve_use_pallas(self, use_pallas: Optional[bool]) -> bool:
         """Decide the kernel path ONCE, at init, from static facts — backend,
@@ -425,9 +440,11 @@ class LLMEngine:
                     attn_mesh=attn_mesh, attn_impl=attn_impl)
                 return model_lib.compute_logits(params, cfg, hidden), kv
 
-        def prefill_step(params, kv: KVCache, int_t, int_b, float_b, key):
+        def prefill_step(params, kv: KVCache, int_t, int_b, float_b,
+                         bias_ids, bias_vals, key):
             # int_b: [B, 4] = (logits_indices, top_k, seed, prompt_len)
             logits, kv = fwd(params, kv, int_t, int_b[:, 0])
+            logits = _maybe_bias(logits, bias_ids, bias_vals)
             logits = _prefill_penalties(cfg, logits, int_t, int_b[:, 3],
                                         float_b[:, 2], float_b[:, 3])
             pos_next = jnp.take(int_t[2], int_b[:, 0]) + 1
@@ -494,9 +511,11 @@ class LLMEngine:
                 return model_lib.compute_logits(params, cfg, hidden), kv
 
         def prefill_hist_step(params, kv: KVCache, int_t, int_b, float_b,
-                              page_table, hist_len, out_tokens, key):
+                              page_table, hist_len, out_tokens,
+                              bias_ids, bias_vals, key):
             logits, kv = hist_fwd(params, kv, int_t, int_b, page_table,
                                   hist_len)
+            logits = _maybe_bias(logits, bias_ids, bias_vals)
             # EXACT penalties on the chunked path: earlier chunks' token ids
             # live in the pool as vectors, not ids, so the histogram comes
             # from a HOST resync (out_tokens [B, cap], -1-padded — the host
@@ -610,7 +629,8 @@ class LLMEngine:
             return toks.T, lps.T, kv    # [B, W] each
 
         def decode_window_sampled(params, kv: KVCache, tokens0, int_b,
-                                  float_b, key, counts, out_tokens, rebuild):
+                                  float_b, key, counts, out_tokens, rebuild,
+                                  bias_ids, bias_vals):
             # Sampled variant adds per-request seed + presence/frequency
             # penalties (vLLM semantics: over generated tokens only). counts
             # [B, V] i32 is the device-resident output-token histogram: it
@@ -636,6 +656,7 @@ class LLMEngine:
                 kv, counts, tokens, pos = carry
                 logits, kv = fwd(params, kv, tokens,
                                  substep_meta(page_tables, pos))
+                logits = _maybe_bias(logits, bias_ids, bias_vals)
                 logits = jax.lax.cond(
                     any_pen,
                     lambda l: apply_penalties(l, counts, presence, frequency),
@@ -661,7 +682,17 @@ class LLMEngine:
 
     def add_request(self, request_id: str, prompt_token_ids: list[int],
                     params: Optional[SamplingParams] = None) -> None:
-        seq = Sequence(request_id, prompt_token_ids, params or SamplingParams(),
+        params = params or SamplingParams()
+        if params.logit_bias:
+            # Out-of-vocab ids would be silently dropped by the device
+            # scatter — reject with a signal instead (OpenAI/vLLM 400).
+            V = self.model_config.vocab_size
+            bad = [t for t in params.logit_bias if t >= V]
+            if bad:
+                raise ValueError(
+                    f"logit_bias token ids {bad[:5]} out of range for "
+                    f"vocab_size {V}")
+        seq = Sequence(request_id, prompt_token_ids, params,
                        eos_token_id=self.eos_token_id)
         self.scheduler.add(seq)
 
@@ -734,11 +765,13 @@ class LLMEngine:
                     # Chunked prefill (solo): chunk attends to pool history.
                     self.stats.prefill_tokens += int(
                         np.sum(batch.seg_ids >= 0))
+                    bias_ids, bias_vals = self._bias_arrays(batch)
                     next_tokens, lps, self.kv_cache = self._prefill_hist_fn(
                         self.params, self.kv_cache, int_t, int_b, float_b,
                         jnp.asarray(batch.page_tables),
                         jnp.int32(batch.hist_len),
-                        self._penalty_out_tokens(batch), step_key)
+                        self._penalty_out_tokens(batch), bias_ids, bias_vals,
+                        step_key)
                     if batch.partial:
                         # Prompt not complete: KV is committed, the sampled
                         # token is meaningless — nothing to report yet.
@@ -746,9 +779,10 @@ class LLMEngine:
                 else:
                     self.stats.prefill_tokens += sum(
                         s.num_tokens for s in batch.seqs)
+                    bias_ids, bias_vals = self._bias_arrays(batch)
                     next_tokens, lps, self.kv_cache = self._prefill_fn(
                         self.params, self.kv_cache, int_t, int_b, float_b,
-                        step_key)
+                        bias_ids, bias_vals, step_key)
                 return drained + self._process_window(
                     batch, np.asarray(next_tokens)[:, None],
                     np.asarray(lps)[:, None], set(), defer=False)
@@ -776,6 +810,28 @@ class LLMEngine:
             self._drain_deferred()
         return outputs
 
+    def _bias_arrays(self, batch: ScheduledBatch):
+        """(bias_ids [B, 300] i32 -1-padded, bias_vals [B, 300] f32) for the
+        device-side logit_bias scatter; cached -1/0 dummies when no request
+        in the batch carries a bias."""
+        B = len(batch.temperature)
+        if not any(seq.params.logit_bias for seq in batch.seqs):
+            if B not in self._dummy_bias:
+                self._dummy_bias[B] = (
+                    jnp.full((B, LOGIT_BIAS_CAP), -1, jnp.int32),
+                    jnp.zeros((B, LOGIT_BIAS_CAP), jnp.float32))
+            return self._dummy_bias[B]
+        ids = np.full((B, LOGIT_BIAS_CAP), -1, np.int32)
+        vals = np.zeros((B, LOGIT_BIAS_CAP), np.float32)
+        for s, seq in enumerate(batch.seqs):
+            lb = seq.params.logit_bias
+            if lb:
+                for j, (tok, bias) in enumerate(list(lb.items())
+                                                [:LOGIT_BIAS_CAP]):
+                    ids[s, j] = tok
+                    vals[s, j] = bias
+        return jnp.asarray(ids), jnp.asarray(vals)
+
     def _penalty_out_tokens(self, batch: ScheduledBatch):
         """[B, out_cap] -1-padded output-token ids for the device-side
         penalty histogram resync; the cached -1 dummy when no request in the
@@ -801,7 +857,8 @@ class LLMEngine:
         self._key, step_key = jax.random.split(self._key)
         greedy = (bool(np.all(batch.temperature <= 0))
                   and not np.any(batch.presence)
-                  and not np.any(batch.frequency))
+                  and not np.any(batch.frequency)
+                  and not any(s.params.logit_bias for s in batch.seqs))
         if greedy:
             dev_out, dev_lp, self.kv_cache = self._decode_fn_greedy(
                 self.params, self.kv_cache, tokens_dev, int_b, float_b,
@@ -830,9 +887,11 @@ class LLMEngine:
             else:
                 out_tokens = self._dummy_out.setdefault(
                     B, jnp.full((B, self._out_cap), -1, jnp.int32))
+            bias_ids, bias_vals = self._bias_arrays(batch)
             dev_out, dev_lp, self.kv_cache, counts = self._decode_fn(
                 self.params, self.kv_cache, tokens_dev, int_b, float_b,
-                step_key, counts, out_tokens, jnp.asarray(rebuild))
+                step_key, counts, out_tokens, jnp.asarray(rebuild),
+                bias_ids, bias_vals)
         return {"batch": batch, "dev_out": dev_out, "dev_lp": dev_lp,
                 "positions": positions, "float_b": float_b, "zombies": set(),
                 "counts": counts}

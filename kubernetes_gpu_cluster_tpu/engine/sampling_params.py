@@ -26,6 +26,8 @@ class SamplingParams:
     presence_penalty: float = 0.0   # [-2, 2]; flat penalty on seen tokens
     frequency_penalty: float = 0.0  # [-2, 2]; scales with occurrence count
     seed: Optional[int] = None      # reproducible sampling per request
+    # OpenAI logit_bias: token id -> additive bias [-100, 100], <= 300 keys.
+    logit_bias: Optional[dict] = None
 
     def __post_init__(self):
         if self.max_tokens < 1:
@@ -42,3 +44,24 @@ class SamplingParams:
             raise ValueError("frequency_penalty must be in [-2, 2]")
         if self.seed is not None and not isinstance(self.seed, int):
             raise ValueError("seed must be an integer")
+        if self.logit_bias is not None:
+            if not isinstance(self.logit_bias, dict):
+                raise ValueError("logit_bias must be a map of token id -> "
+                                 "bias")
+            if len(self.logit_bias) > 300:
+                raise ValueError("logit_bias supports at most 300 tokens")
+            clean = {}
+            for k, v in self.logit_bias.items():
+                try:
+                    tok, bias = int(k), float(v)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        "logit_bias keys must be token ids and values "
+                        "numbers") from None
+                if tok < 0:
+                    raise ValueError("logit_bias token ids must be >= 0")
+                if not (-100.0 <= bias <= 100.0):
+                    raise ValueError("logit_bias values must be in "
+                                     "[-100, 100]")
+                clean[tok] = bias
+            self.logit_bias = clean

@@ -112,6 +112,18 @@ def apply_penalties(logits: jax.Array, counts: jax.Array,
             - frequency[:, None] * c)
 
 
+def apply_logit_bias(logits: jax.Array, bias_ids: jax.Array,
+                     bias_vals: jax.Array) -> jax.Array:
+    """OpenAI ``logit_bias``: per-request sparse additive bias, applied to
+    the raw logits prior to sampling (before penalties/temperature).
+    bias_ids [B, K] int32 (-1 = empty slot), bias_vals [B, K] f32."""
+    B = logits.shape[0]
+    valid = bias_ids >= 0
+    ids = jnp.where(valid, bias_ids, 0)
+    vals = jnp.where(valid, bias_vals, 0.0).astype(logits.dtype)
+    return logits.at[jnp.arange(B)[:, None], ids].add(vals)
+
+
 def build_counts(out_tokens: jax.Array, vocab_size: int) -> jax.Array:
     """[B, CAP] -1-padded output-token ids -> [B, V] int32 counts (one
     scatter-add; runs once per decode window when the host re-synchronizes

@@ -49,6 +49,7 @@ def _sampling_params(body: dict, eos_token_id: Optional[int]) -> SamplingParams:
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
         seed=int(seed) if seed is not None else None,
+        logit_bias=body.get("logit_bias") or None,
     )
 
 
@@ -225,17 +226,26 @@ class APIServer:
         stream = bool(body.get("stream"))
         try:
             n = 1 if body.get("n") is None else int(body["n"])
+            best_of = n if body.get("best_of") is None else int(body["best_of"])
         except (TypeError, ValueError):
-            return _error(400, "n must be an integer")
+            return _error(400, "n/best_of must be integers")
         if n < 1:
             return _error(400, "n must be >= 1")
         if n > 128:   # OpenAI's cap; bounds queue/memory blast radius
             return _error(400, "n must be <= 128")
-        if n > 1:
+        if best_of < n:
+            return _error(400, "best_of must be >= n")
+        if best_of > 128:
+            return _error(400, "best_of must be <= 128")
+        if best_of != n and kind != "completion":
+            return _error(400, "best_of is supported on /v1/completions only")
+        if n > 1 or best_of > 1:
             if stream:
-                return _error(400, "n > 1 with stream is not supported")
+                return _error(400, "n/best_of > 1 with stream is not "
+                                   "supported")
             return await self._run_n(body, ids, params, kind, rid, created,
-                                     n, want_lps, echo_prefix)
+                                     n, want_lps, echo_prefix,
+                                     best_of=best_of)
         self.metrics.on_request()
 
         # ``complete`` guards the engine-side abort: any early handler exit —
@@ -320,26 +330,34 @@ class APIServer:
         return resp
 
     async def _run_n(self, body, ids, params, kind, rid, created, n,
-                     want_lps, echo_prefix="") -> web.Response:
-        """OpenAI ``n`` > 1: n engine requests for one prompt, gathered
-        concurrently into n choices (with prefix caching enabled the n-1
-        duplicates reuse the prompt's KV pages). Greedy sampling yields n
-        identical choices — same as vLLM; use temperature > 0 for variety."""
+                     want_lps, echo_prefix="", best_of=None) -> web.Response:
+        """OpenAI ``n`` > 1 / ``best_of``: best_of engine requests for one
+        prompt, gathered concurrently (with prefix caching enabled the
+        duplicates reuse the prompt's KV pages); when best_of > n, choices
+        are ranked by mean token logprob (vLLM's cumulative-logprob
+        selection, length-normalized) and the top n returned. Greedy
+        sampling yields identical candidates — same as vLLM; use
+        temperature > 0 for variety."""
         import asyncio
+        import dataclasses
 
         self.metrics.on_request()
+        best_of = n if best_of is None else best_of
+        # Ranking needs per-token logprobs even when the client didn't ask.
+        run_params = (dataclasses.replace(params, logprobs=True)
+                      if best_of > n and not params.logprobs else params)
 
         async def one(i):
             sub = f"{rid}-{i}"
             detok = IncrementalDetokenizer(self.tokenizer, stop=_stops(body))
-            # Seeded n>1: each choice gets a derived sub-seed (choice 0 keeps
-            # the base seed, matching n=1) — same request => same n choices,
-            # but the choices differ from each other (OpenAI/vLLM behavior).
-            p_i = params
+            # Seeded fan-out: each candidate gets a derived sub-seed (choice
+            # 0 keeps the base seed, matching n=1) — same request => same
+            # candidates, but the candidates differ from each other
+            # (OpenAI/vLLM behavior).
+            p_i = run_params
             if params.seed is not None and i > 0:
-                import dataclasses
                 p_i = dataclasses.replace(
-                    params, seed=(params.seed + i) & 0x7fffffff)
+                    run_params, seed=(params.seed + i) & 0x7fffffff)
             gen = self.engine.generate(sub, list(ids), p_i)
             complete = False
             try:
@@ -354,7 +372,7 @@ class APIServer:
         # running unobserved: every result is collected, surviving children
         # are aborted explicitly on error, and no "Task exception was never
         # retrieved" warnings or device-time leaks remain.
-        results = await asyncio.gather(*(one(i) for i in range(n)),
+        results = await asyncio.gather(*(one(i) for i in range(best_of)),
                                        return_exceptions=True)
         errors = [r for r in results if isinstance(r, BaseException)]
         if errors:
@@ -365,8 +383,20 @@ class APIServer:
             if all(isinstance(e, ValueError) for e in errors):
                 return _error(400, str(errors[0]))
             raise errors[0]
+        # Usage counts ALL generated candidates (OpenAI bills every best_of
+        # completion), not just the returned ones.
+        discarded_out = 0
+        if best_of > n:
+            def mean_lp(res):
+                lps = res[4]
+                return sum(lps) / len(lps) if lps else float("-inf")
+            results = sorted(results, key=mean_lp, reverse=True)
+            discarded_out = sum(r[2] for r in results[n:])
+            results = results[:n]
+            if not params.logprobs:       # ranking-only logprobs: strip
+                results = [(t, fr, no, ti, []) for t, fr, no, ti, _ in results]
         choices = []
-        total_out = 0
+        total_out = discarded_out
         for i, (text, finish_reason, n_out, tok_ids, tok_lps) in enumerate(results):
             total_out += n_out
             if echo_prefix:

@@ -180,6 +180,50 @@ def test_penalty_params_validated():
     assert SamplingParams(seed=-1).seed == -1
 
 
+def test_logit_bias_forces_and_bans_tokens():
+    """OpenAI logit_bias semantics: +100 effectively forces a token, -100
+    bans it — across prefill (first token) AND decode windows, greedy and
+    sampled dispatch paths."""
+    eng = make_engine()
+    prompt = [3, 1, 4]
+    forced = eng.generate([prompt], SamplingParams(
+        max_tokens=6, temperature=0.0, logit_bias={7: 100.0}))[0]
+    assert forced.output_token_ids == [7] * 6
+
+    greedy = eng.generate([prompt], SamplingParams(
+        max_tokens=4, temperature=0.0))[0]
+    banned_tok = greedy.output_token_ids[0]
+    banned = eng.generate([prompt], SamplingParams(
+        max_tokens=4, temperature=0.0, logit_bias={banned_tok: -100.0}))[0]
+    assert banned.output_token_ids[0] != banned_tok
+
+    sampled = eng.generate([prompt], SamplingParams(
+        max_tokens=6, temperature=1.0, seed=1, logit_bias={9: 100.0}))[0]
+    assert sampled.output_token_ids == [9] * 6
+
+    # out-of-vocab ids are rejected at submission, not silently dropped
+    with pytest.raises(ValueError, match="out of range"):
+        eng.add_request("bad", prompt, SamplingParams(
+            logit_bias={10 ** 6: -100.0}))
+
+
+def test_logit_bias_validation():
+    with pytest.raises(ValueError):
+        SamplingParams(logit_bias=[1, 2])
+    with pytest.raises(ValueError):
+        SamplingParams(logit_bias="abc")
+    with pytest.raises(ValueError):
+        SamplingParams(logit_bias={5: 101.0})
+    with pytest.raises(ValueError):
+        SamplingParams(logit_bias={-2: 1.0})
+    with pytest.raises(ValueError):
+        SamplingParams(logit_bias={"x": 1.0})
+    with pytest.raises(ValueError):
+        SamplingParams(logit_bias={i: 1.0 for i in range(301)})
+    # string keys (json) are coerced
+    assert SamplingParams(logit_bias={"5": 1}).logit_bias == {5: 1.0}
+
+
 def test_stochastic_sampling_runs():
     eng = make_engine()
     outs = eng.generate([[1, 2, 3]] * 2,

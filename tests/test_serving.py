@@ -426,6 +426,35 @@ class TestSamplingTailAPI:
             assert la == lb
         loop.run_until_complete(go())
 
+    def test_logit_bias_and_best_of(self, api_client):
+        loop, client = api_client
+
+        async def go():
+            # logit_bias forces the token end-to-end over the API
+            r = await client.post("/v1/completions", json={
+                "prompt": [3, 1], "max_tokens": 3, "temperature": 0.0,
+                "logit_bias": {"70": 100}, "logprobs": 1})
+            assert r.status == 200
+            # token id 70 maps to byte 'C' in the byte tokenizer (70-3=67)
+            body = await r.json()
+            assert body["choices"][0]["text"] == "CCC"
+
+            r2 = await client.post("/v1/completions", json={
+                "prompt": [3, 1], "max_tokens": 2, "logit_bias": {"5": 200}})
+            assert r2.status == 400
+
+            # best_of: 3 candidates, top-1 by mean logprob returned
+            r3 = await client.post("/v1/completions", json={
+                "prompt": [2, 8], "max_tokens": 4, "temperature": 1.0,
+                "seed": 9, "best_of": 3})
+            assert r3.status == 200
+            assert len((await r3.json())["choices"]) == 1
+
+            r4 = await client.post("/v1/completions", json={
+                "prompt": [2, 8], "max_tokens": 2, "n": 3, "best_of": 2})
+            assert r4.status == 400
+        loop.run_until_complete(go())
+
     def test_penalties_accepted_and_validated(self, api_client):
         loop, client = api_client
 
