@@ -34,10 +34,13 @@ from ..models.llama import DecodeMeta, PrefillMeta
 from ..ops.sampling import (apply_logit_bias, apply_penalties, build_counts,
                             bump_counts, row_sample_keys,
                             sample_and_logprobs, token_logprobs)
+from ..utils import cdiv, get_logger
+from .kv_cache import KVCache, allocate_kv_cache, derive_num_pages
+from .sampling_params import LOGIT_BIAS_CAP, SamplingParams
+from .scheduler import ScheduledBatch, Scheduler
+from .sequence import FinishReason, Sequence, SequenceStatus
 
-# OpenAI's logit_bias cap; the device-side sparse-bias buffers are padded to
-# this width (uploaded only when a batch actually carries biases).
-LOGIT_BIAS_CAP = 300
+logger = get_logger("engine")
 
 
 def _maybe_bias(logits, bias_ids, bias_vals):
@@ -48,13 +51,6 @@ def _maybe_bias(logits, bias_ids, bias_vals):
         jnp.any(bias_ids >= 0),
         lambda l: apply_logit_bias(l, bias_ids, bias_vals),
         lambda l: l, logits)
-from ..utils import cdiv, get_logger
-from .kv_cache import KVCache, allocate_kv_cache, derive_num_pages
-from .sampling_params import SamplingParams
-from .scheduler import ScheduledBatch, Scheduler
-from .sequence import FinishReason, Sequence, SequenceStatus
-
-logger = get_logger("engine")
 
 
 @dataclasses.dataclass
@@ -825,9 +821,8 @@ class LLMEngine:
         vals = np.zeros((B, LOGIT_BIAS_CAP), np.float32)
         for s, seq in enumerate(batch.seqs):
             lb = seq.params.logit_bias
-            if lb:
-                for j, (tok, bias) in enumerate(list(lb.items())
-                                                [:LOGIT_BIAS_CAP]):
+            if lb:   # validated <= LOGIT_BIAS_CAP at SamplingParams init
+                for j, (tok, bias) in enumerate(lb.items()):
                     ids[s, j] = tok
                     vals[s, j] = bias
         return jnp.asarray(ids), jnp.asarray(vals)

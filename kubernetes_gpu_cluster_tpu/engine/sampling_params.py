@@ -13,6 +13,10 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Sequence
 
+# OpenAI's logit_bias key cap; also sizes the engine's device-side sparse
+# bias buffers (engine/engine.py).
+LOGIT_BIAS_CAP = 300
+
 
 @dataclasses.dataclass
 class SamplingParams:
@@ -48,8 +52,9 @@ class SamplingParams:
             if not isinstance(self.logit_bias, dict):
                 raise ValueError("logit_bias must be a map of token id -> "
                                  "bias")
-            if len(self.logit_bias) > 300:
-                raise ValueError("logit_bias supports at most 300 tokens")
+            if len(self.logit_bias) > LOGIT_BIAS_CAP:
+                raise ValueError(
+                    f"logit_bias supports at most {LOGIT_BIAS_CAP} tokens")
             clean = {}
             for k, v in self.logit_bias.items():
                 try:

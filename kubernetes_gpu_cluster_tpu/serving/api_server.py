@@ -334,8 +334,9 @@ class APIServer:
         """OpenAI ``n`` > 1 / ``best_of``: best_of engine requests for one
         prompt, gathered concurrently (with prefix caching enabled the
         duplicates reuse the prompt's KV pages); when best_of > n, choices
-        are ranked by mean token logprob (vLLM's cumulative-logprob
-        selection, length-normalized) and the top n returned. Greedy
+        are ranked by CUMULATIVE logprob (vLLM's selection rule — sum, not
+        mean, so shorter candidates rank higher) and the top n returned.
+        Greedy
         sampling yields identical candidates — same as vLLM; use
         temperature > 0 for variety."""
         import asyncio
@@ -387,10 +388,10 @@ class APIServer:
         # completion), not just the returned ones.
         discarded_out = 0
         if best_of > n:
-            def mean_lp(res):
+            def cum_lp(res):
                 lps = res[4]
-                return sum(lps) / len(lps) if lps else float("-inf")
-            results = sorted(results, key=mean_lp, reverse=True)
+                return sum(lps) if lps else float("-inf")
+            results = sorted(results, key=cum_lp, reverse=True)
             discarded_out = sum(r[2] for r in results[n:])
             results = results[:n]
             if not params.logprobs:       # ranking-only logprobs: strip

@@ -385,3 +385,23 @@ def test_pp_hist_no_layer_stack_gather():
     offending = [ln for ln in txt.splitlines()
                  if "all-gather" in ln and stacked_marker in ln]
     assert not offending, offending[:3]
+
+
+def test_sampled_tail_features_under_mesh():
+    """Seeded sampling, penalties, and logit_bias must work UNDER a GSPMD
+    mesh (the sampled decode program's counts/out_tokens/bias buffers ride
+    pjit like any other input) and reproduce the single-device outputs —
+    seeded rows are batch/mesh-invariant by construction."""
+    cfg = EngineConfig.from_model_name("debug-tiny")
+    params = model_lib.init_params(cfg.model, jax.random.key(0))
+    sp = [SamplingParams(max_tokens=10, temperature=0.8, seed=5,
+                         frequency_penalty=1.0, presence_penalty=0.5),
+          SamplingParams(max_tokens=10, temperature=0.0,
+                         logit_bias={7: 100.0})]
+    prompts = [[3, 1, 4], [2, 7, 1]]
+    ref = LLMEngine(cfg, params=params).generate(prompts, sp)
+    mesh_eng = LLMEngine(cfg, params=params, mesh=make_mesh(tp=4, dp=2))
+    got = mesh_eng.generate(prompts, sp)
+    assert got[1].output_token_ids == [7] * 10
+    for a, b in zip(ref, got):
+        assert a.output_token_ids == b.output_token_ids
