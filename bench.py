@@ -81,10 +81,12 @@ LOAD_UTILIZATION = float(os.environ.get("KGCT_BENCH_LOAD_UTIL", 0.7))
 
 
 def _mk_engine(model_name: str, quant, batch: int, max_new: int,
-               window: int, budget: int):
+               window: int, budget: int, page_slack: int = 3):
     on_tpu = jax.default_backend() == "tpu"
     page = PAGE if PAGE is not None else (128 if on_tpu else 16)
-    pages_per_seq = (PROMPT_LEN + max_new) // page + 3
+    # Ceil-divide: a floor here under-provisions the pool whenever the page
+    # size doesn't divide the sequence budget (fatal with page_slack=0).
+    pages_per_seq = -(-(PROMPT_LEN + max_new) // page) + page_slack
     cfg = EngineConfig(
         model=get_model_config(model_name).replace(quantization=quant),
         cache=CacheConfig(page_size=page, num_pages=batch * pages_per_seq + 1),
@@ -322,12 +324,15 @@ def _measure_sustained(engine, rng, vocab, batch, rate_rps):
 
 def run_config(model_name: str, quant, batch: int, *, sustained: bool,
                host_rt_s: float, rng, window: int = None, budget: int = None,
-               n_windows: int = None) -> dict:
+               n_windows: int = None, page_slack: int = 3,
+               max_new: int = None) -> dict:
     window = window or DECODE_WINDOW
     budget = budget or PREFILL_BUDGET
     n_windows = n_windows or BENCH_WINDOWS
-    max_new = PROMPT_LEN + window * (WARMUP_WINDOWS + n_windows + 4)
-    engine = _mk_engine(model_name, quant, batch, max_new, window, budget)
+    max_new = max_new or (
+        PROMPT_LEN + window * (WARMUP_WINDOWS + n_windows + 4))
+    engine = _mk_engine(model_name, quant, batch, max_new, window, budget,
+                        page_slack)
     vocab = engine.config.model.vocab_size
 
     # Warmup: compile prefill + greedy decode programs.
@@ -399,15 +404,21 @@ def main() -> None:
     elif on_tpu:
         # Default driver suite: continuity line first (its engine is small),
         # then the PRIMARY 8B int8 config (BASELINE config 2) with the
-        # sustained-load phase. 8B geometry is HBM-bound on the 16 GB chip:
-        # B=32 / W=32 / budget 2048 is the proven fit (B=48 OOMs at 17.25 GB
-        # r4; W=48 + budget 4096 OOMs at 17.50 GB: KV pool + the prefill
-        # program's KV layout copy + weights exceed HBM).
+        # sustained-load phase. 8B decode is weight-streaming-bound, so
+        # tokens/step scale with batch until HBM runs out; the r5 batch
+        # ladder (interleaved probes): B=32 2335 -> B=48 3027 -> B=56 3335
+        # -> B=64 3650 tok/s median; B=72 flat (3634), B=80/B=64-at-5-pages
+        # OOM by ~1 MB. The fit is an EXACTLY-4-page zero-slack pool
+        # (prompt 128 + max_new 384 = 512 tokens/seq; a non-dividing
+        # max_new would floor to an under-provisioned pool) + W=28 so 13
+        # windows fit the 384-token budget. Slack-0 only risks a graceful
+        # chain break at the request tail. r4's +3-slack B=48 OOM'd 17.25G.
         configs = [dict(model_name="tinyllama-1.1b", quant=None,
                         batch=int(os.environ.get("KGCT_BENCH_BATCH", 64)),
                         sustained=False),
-                   dict(model_name="llama-3-8b", quant="int8", batch=32,
-                        sustained=True, window=32, budget=2048, n_windows=9)]
+                   dict(model_name="llama-3-8b", quant="int8", batch=64,
+                        sustained=True, window=28, budget=2048, n_windows=9,
+                        page_slack=0, max_new=384)]
     else:
         configs = [dict(model_name="debug-tiny", quant=None,
                         batch=int(os.environ.get("KGCT_BENCH_BATCH", 8)),
@@ -433,8 +444,8 @@ def main() -> None:
         "baseline_bar": {"value": bar,
                          "source": ("chosen constant (A100 vLLM class bar)"
                                     if bar else "no bar defined for model")},
-        "decode_window": DECODE_WINDOW,
-        "prefill_budget": PREFILL_BUDGET,
+        "decode_window": primary["decode_window"],
+        "prefill_budget": primary["prefill_budget"],
         "configs": results,
     }
     print(json.dumps(out))
