@@ -41,6 +41,7 @@ import numpy as np
 from kubernetes_gpu_cluster_tpu.config import (
     CacheConfig, EngineConfig, SchedulerConfig, get_model_config)
 from kubernetes_gpu_cluster_tpu.engine import LLMEngine, SamplingParams
+from kubernetes_gpu_cluster_tpu.utils import cdiv
 
 # SELF-CHOSEN comparison bars, not measured or published numbers: vLLM-class
 # single-A100 decode throughput per model class (batch ~64 / ~32 for 8B).
@@ -86,7 +87,7 @@ def _mk_engine(model_name: str, quant, batch: int, max_new: int,
     page = PAGE if PAGE is not None else (128 if on_tpu else 16)
     # Ceil-divide: a floor here under-provisions the pool whenever the page
     # size doesn't divide the sequence budget (fatal with page_slack=0).
-    pages_per_seq = -(-(PROMPT_LEN + max_new) // page) + page_slack
+    pages_per_seq = cdiv(PROMPT_LEN + max_new, page) + page_slack
     cfg = EngineConfig(
         model=get_model_config(model_name).replace(quantization=quant),
         cache=CacheConfig(page_size=page, num_pages=batch * pages_per_seq + 1),
@@ -413,9 +414,16 @@ def main() -> None:
         # max_new would floor to an under-provisioned pool) + W=28 so 13
         # windows fit the 384-token budget. Slack-0 only risks a graceful
         # chain break at the request tail. r4's +3-slack B=48 OOM'd 17.25G.
+        # tinyllama runs twice: B=64 is the r1-r4 continuity line, B=256 the
+        # batch-optimal point (same weight-amortization ladder as 8B: 9.9k
+        # -> 13.8k (B=128) -> 15.4k (192) -> 16.2k (256) tok/s; B=320
+        # fails compile). Larger batches trade fresh-batch TTFT for
+        # throughput — both points are reported.
         configs = [dict(model_name="tinyllama-1.1b", quant=None,
                         batch=int(os.environ.get("KGCT_BENCH_BATCH", 64)),
                         sustained=False),
+                   dict(model_name="tinyllama-1.1b", quant=None, batch=256,
+                        sustained=False, n_windows=9),  # 11-page pool fit
                    dict(model_name="llama-3-8b", quant="int8", batch=64,
                         sustained=True, window=28, budget=2048, n_windows=9,
                         page_slack=0, max_new=384)]

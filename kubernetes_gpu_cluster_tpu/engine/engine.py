@@ -903,12 +903,8 @@ class LLMEngine:
         grows = []
         total = 0
         for s, seq in enumerate(batch.seqs):
-            # Clamp growth to the request's own budget too (see
-            # scheduler._schedule_decode): past-budget window-tail writes
-            # route to the scrap page, so exactly-sized pools never thrash.
-            last_pos = min(int(new_positions[s]) + W - 1,
-                           self.config.effective_max_len - 1,
-                           seq.num_prompt_tokens + seq.params.max_tokens - 1)
+            last_pos = seq.last_window_pos(
+                int(new_positions[s]), W, self.config.effective_max_len)
             need = cdiv(last_pos + 1, ps) - len(seq.pages)
             if need > 0:
                 grows.append((s, seq, need))

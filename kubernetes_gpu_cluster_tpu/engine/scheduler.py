@@ -396,16 +396,10 @@ class Scheduler:
         idx = 0
         while idx < len(self.running):
             seq = self.running[idx]
-            # Window inputs occupy positions num_tokens-1 .. num_tokens+W-2;
-            # clamp to the model length cap AND the sequence's own
-            # max_tokens budget (tokens past it are host-truncated anyway;
-            # the device routes out-of-page writes to the scrap page). The
-            # request-budget clamp is what makes EXACTLY-sized page pools
-            # safe — without it, window tails demand pages the request can
-            # never use.
-            last_pos = min(seq.num_tokens + W - 2,
-                           self.config.effective_max_len - 1,
-                           seq.num_prompt_tokens + seq.params.max_tokens - 1)
+            # Window inputs occupy positions num_tokens-1 .. num_tokens+W-2
+            # (see Sequence.last_window_pos for the clamp rationale).
+            last_pos = seq.last_window_pos(
+                seq.num_tokens - 1, W, self.config.effective_max_len)
             pages_needed = cdiv(last_pos + 1, self.page_size)
             grow = pages_needed - len(seq.pages)
             if grow > 0:

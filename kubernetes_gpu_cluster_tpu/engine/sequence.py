@@ -65,6 +65,18 @@ class Sequence:
     def num_tokens(self) -> int:
         return self.num_prompt_tokens + self.num_output_tokens
 
+    def last_window_pos(self, next_input_pos: int, window: int,
+                        max_len: int) -> int:
+        """Highest position a decode window starting its inputs at
+        ``next_input_pos`` can touch, clamped to the model cap AND this
+        request's own max_tokens budget. Window-tail tokens past either
+        bound route to the scrap page, so page growth sized by this bound
+        makes EXACTLY-sized pools safe (no pages a request can never use).
+        The single source of truth for scheduler._schedule_decode and the
+        speculative chain's engine._advance_window."""
+        return min(next_input_pos + window - 1, max_len - 1,
+                   self.num_prompt_tokens + self.params.max_tokens - 1)
+
     @property
     def is_finished(self) -> bool:
         return self.status == SequenceStatus.FINISHED
