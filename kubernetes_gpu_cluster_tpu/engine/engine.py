@@ -32,7 +32,7 @@ from ..config import EngineConfig
 from ..models import llama as model_lib
 from ..models.llama import DecodeMeta, PrefillMeta
 from ..ops.sampling import (apply_logit_bias, apply_penalties, build_counts,
-                            bump_counts, row_sample_keys,
+                            bump_counts, gated_top_logprobs, row_sample_keys,
                             sample_and_logprobs, token_logprobs)
 from ..utils import cdiv, get_logger
 from .kv_cache import KVCache, allocate_kv_cache, derive_num_pages
@@ -84,6 +84,10 @@ class RequestOutput:
     new_token_ids: Optional[list[int]] = None  # tokens produced this step
     new_logprobs: Optional[list[float]] = None  # chosen-token logprobs, ditto
     output_logprobs: Optional[list[float]] = None  # full per-token record
+    # OpenAI logprobs=N alternatives: per new token, [(token_id, logprob)]
+    # of the N most likely tokens (N = SamplingParams.top_logprobs).
+    new_top_logprobs: Optional[list[list[tuple[int, float]]]] = None
+    output_top_logprobs: Optional[list[list[tuple[int, float]]]] = None
 
 
 def _prefill_penalties(cfg, logits, int_t, prompt_lens, presence, frequency):
@@ -438,17 +442,18 @@ class LLMEngine:
 
         def prefill_step(params, kv: KVCache, int_t, int_b, float_b,
                          bias_ids, bias_vals, key):
-            # int_b: [B, 4] = (logits_indices, top_k, seed, prompt_len)
+            # int_b: [B, 5] = (logits_indices, top_k, seed, prompt_len,
+            # top_n)
             logits, kv = fwd(params, kv, int_t, int_b[:, 0])
             logits = _maybe_bias(logits, bias_ids, bias_vals)
             logits = _prefill_penalties(cfg, logits, int_t, int_b[:, 3],
                                         float_b[:, 2], float_b[:, 3])
             pos_next = jnp.take(int_t[2], int_b[:, 0]) + 1
             keys = row_sample_keys(key, int_b[:, 2], pos_next)
-            next_tokens, lps = sample_and_logprobs(
+            next_tokens, lps, tids, tlps = sample_and_logprobs(
                 logits, keys, float_b[:, 0], int_b[:, 1], float_b[:, 1],
-                row_keys=True)
-            return next_tokens, lps, kv
+                row_keys=True, with_top=jnp.any(int_b[:, 4] > 0))
+            return next_tokens, lps, tids, tlps, kv
 
         return self._maybe_jit(prefill_step, donate_argnums=(1,))
 
@@ -527,10 +532,10 @@ class LLMEngine:
                 lambda l: l, logits)
             pos_next = jnp.take(int_t[2], int_b[:, 0]) + 1
             keys = row_sample_keys(key, int_b[:, 2], pos_next)
-            next_tokens, lps = sample_and_logprobs(
+            next_tokens, lps, tids, tlps = sample_and_logprobs(
                 logits, keys, float_b[:, 0], int_b[:, 1], float_b[:, 1],
-                row_keys=True)
-            return next_tokens, lps, kv
+                row_keys=True, with_top=jnp.any(int_b[:, 4] > 0))
+            return next_tokens, lps, tids, tlps, kv
 
         return self._maybe_jit(prefill_hist_step, donate_argnums=(1,))
 
@@ -604,13 +609,15 @@ class LLMEngine:
                                  float_b, key):
             # tokens0: [B] — separate so chained windows can feed the previous
             # window's device-resident output column without a host roundtrip.
-            # int_b: [B, pps+3] = (positions, top_k, seed, page_table...),
-            # float_b: [B, 4] = (temperature, top_p, presence, frequency).
-            # Slots/context lens are recomputed per sub-step from positions +
-            # page tables. The greedy program ignores the sampling columns —
-            # it is only dispatched for all-greedy, penalty-free batches.
+            # int_b: [B, pps+4] = (positions, top_k, seed, top_n,
+            # page_table...), float_b: [B, 4] = (temperature, top_p,
+            # presence, frequency). Slots/context lens are recomputed per
+            # sub-step from positions + page tables. The greedy program
+            # ignores the sampling columns — it is only dispatched for
+            # all-greedy, penalty-free, bias-free batches.
             positions0 = int_b[:, 0]
-            page_tables = int_b[:, 3:]
+            any_top = jnp.any(int_b[:, 3] > 0)
+            page_tables = int_b[:, 4:]
 
             def substep(carry, i):
                 kv, tokens, pos = carry
@@ -618,11 +625,15 @@ class LLMEngine:
                                  substep_meta(page_tables, pos))
                 next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 lps = token_logprobs(logits, next_tokens)
-                return (kv, next_tokens, pos + 1), (next_tokens, lps)
+                tids, tlps = gated_top_logprobs(logits, any_top)
+                return ((kv, next_tokens, pos + 1),
+                        (next_tokens, lps, tids, tlps))
 
-            (kv, _, _), (toks, lps) = jax.lax.scan(
+            (kv, _, _), (toks, lps, tids, tlps) = jax.lax.scan(
                 substep, (kv, tokens0, positions0), jnp.arange(W))
-            return toks.T, lps.T, kv    # [B, W] each
+            # [B, W] / [B, W, K]
+            return (toks.T, lps.T, tids.transpose(1, 0, 2),
+                    tlps.transpose(1, 0, 2), kv)
 
         def decode_window_sampled(params, kv: KVCache, tokens0, int_b,
                                   float_b, key, counts, out_tokens, rebuild,
@@ -638,7 +649,8 @@ class LLMEngine:
             positions0 = int_b[:, 0]
             top_k = int_b[:, 1]
             seed = int_b[:, 2]
-            page_tables = int_b[:, 3:]
+            any_top = jnp.any(int_b[:, 3] > 0)
+            page_tables = int_b[:, 4:]
             temperature = float_b[:, 0]
             top_p = float_b[:, 1]
             presence = float_b[:, 2]
@@ -658,16 +670,19 @@ class LLMEngine:
                     lambda l: apply_penalties(l, counts, presence, frequency),
                     lambda l: l, logits)
                 keys = row_sample_keys(key, seed, pos + 1)
-                next_tokens, lps = sample_and_logprobs(
-                    logits, keys, temperature, top_k, top_p, row_keys=True)
+                next_tokens, lps, tids, tlps = sample_and_logprobs(
+                    logits, keys, temperature, top_k, top_p, row_keys=True,
+                    with_top=any_top)
                 counts = jax.lax.cond(
                     any_pen, lambda c: bump_counts(c, next_tokens),
                     lambda c: c, counts)
-                return (kv, counts, next_tokens, pos + 1), (next_tokens, lps)
+                return ((kv, counts, next_tokens, pos + 1),
+                        (next_tokens, lps, tids, tlps))
 
-            (kv, counts, _, _), (toks, lps) = jax.lax.scan(
+            (kv, counts, _, _), (toks, lps, tids, tlps) = jax.lax.scan(
                 substep, (kv, counts, tokens0, positions0), jnp.arange(W))
-            return toks.T, lps.T, kv, counts
+            return (toks.T, lps.T, tids.transpose(1, 0, 2),
+                    tlps.transpose(1, 0, 2), kv, counts)
 
         if greedy:
             return self._maybe_jit(decode_window_greedy, donate_argnums=(1,))
@@ -756,13 +771,14 @@ class LLMEngine:
                      batch.slot_mapping]))
                 int_b = jnp.asarray(np.stack(
                     [batch.logits_indices, batch.top_k, batch.seed,
-                     batch.prompt_lens], axis=1))
+                     batch.prompt_lens, batch.top_n], axis=1))
                 if batch.hist_len is not None:
                     # Chunked prefill (solo): chunk attends to pool history.
                     self.stats.prefill_tokens += int(
                         np.sum(batch.seg_ids >= 0))
                     bias_ids, bias_vals = self._bias_arrays(batch)
-                    next_tokens, lps, self.kv_cache = self._prefill_hist_fn(
+                    (next_tokens, lps, tids, tlps,
+                     self.kv_cache) = self._prefill_hist_fn(
                         self.params, self.kv_cache, int_t, int_b, float_b,
                         jnp.asarray(batch.page_tables),
                         jnp.int32(batch.hist_len),
@@ -776,12 +792,18 @@ class LLMEngine:
                     self.stats.prefill_tokens += sum(
                         s.num_tokens for s in batch.seqs)
                     bias_ids, bias_vals = self._bias_arrays(batch)
-                    next_tokens, lps, self.kv_cache = self._prefill_fn(
+                    (next_tokens, lps, tids, tlps,
+                     self.kv_cache) = self._prefill_fn(
                         self.params, self.kv_cache, int_t, int_b, float_b,
                         bias_ids, bias_vals, step_key)
+                top_i = top_l = None
+                if any(s.params.top_logprobs for s in batch.seqs):
+                    top_i = np.asarray(tids)[:, None]
+                    top_l = np.asarray(tlps)[:, None]
                 return drained + self._process_window(
                     batch, np.asarray(next_tokens)[:, None],
-                    np.asarray(lps)[:, None], set(), defer=False)
+                    np.asarray(lps)[:, None], set(), defer=False,
+                    top_ids=top_i, top_lps=top_l)
             inflight = self._dispatch_window(
                 batch, jnp.asarray(batch.tokens), batch.positions, float_b)
             inflight["drained"] = drained
@@ -792,10 +814,16 @@ class LLMEngine:
 
         toks = np.asarray(inflight["dev_out"])   # syncs; overlaps successor
         lps = np.asarray(inflight["dev_lp"])
+        top_i = top_l = None
+        if any(s.params.top_logprobs for s in inflight["batch"].seqs):
+            # Alternatives ride the device outputs unconditionally; the
+            # device->host TRANSFER happens only when someone asked.
+            top_i = np.asarray(inflight["dev_tid"])
+            top_l = np.asarray(inflight["dev_tlp"])
         self._inflight = successor
         outputs = inflight.pop("drained", []) + self._process_window(
             inflight["batch"], toks, lps, inflight["zombies"],
-            defer=successor is not None)
+            defer=successor is not None, top_ids=top_i, top_lps=top_l)
         if successor is not None:
             successor["zombies"].update(
                 s.request_id for s in inflight["batch"].seqs if s.is_finished)
@@ -847,15 +875,16 @@ class LLMEngine:
                          positions: np.ndarray, float_b,
                          counts=None) -> dict:
         int_b = jnp.asarray(np.concatenate(
-            [np.stack([positions, batch.top_k, batch.seed], axis=1),
-             batch.page_tables], axis=1))
+            [np.stack([positions, batch.top_k, batch.seed, batch.top_n],
+                      axis=1), batch.page_tables], axis=1))
         self._key, step_key = jax.random.split(self._key)
         greedy = (bool(np.all(batch.temperature <= 0))
                   and not np.any(batch.presence)
                   and not np.any(batch.frequency)
                   and not any(s.params.logit_bias for s in batch.seqs))
         if greedy:
-            dev_out, dev_lp, self.kv_cache = self._decode_fn_greedy(
+            (dev_out, dev_lp, dev_tid, dev_tlp,
+             self.kv_cache) = self._decode_fn_greedy(
                 self.params, self.kv_cache, tokens_dev, int_b, float_b,
                 step_key)
             counts = None
@@ -883,11 +912,13 @@ class LLMEngine:
                 out_tokens = self._dummy_out.setdefault(
                     B, jnp.full((B, self._out_cap), -1, jnp.int32))
             bias_ids, bias_vals = self._bias_arrays(batch)
-            dev_out, dev_lp, self.kv_cache, counts = self._decode_fn(
+            (dev_out, dev_lp, dev_tid, dev_tlp, self.kv_cache,
+             counts) = self._decode_fn(
                 self.params, self.kv_cache, tokens_dev, int_b, float_b,
                 step_key, counts, out_tokens, jnp.asarray(rebuild),
                 bias_ids, bias_vals)
         return {"batch": batch, "dev_out": dev_out, "dev_lp": dev_lp,
+                "dev_tid": dev_tid, "dev_tlp": dev_tlp,
                 "positions": positions, "float_b": float_b, "zombies": set(),
                 "counts": counts}
 
@@ -921,7 +952,9 @@ class LLMEngine:
 
     def _process_window(self, batch: ScheduledBatch, next_tokens: np.ndarray,
                         logprobs: np.ndarray, zombies: set,
-                        defer: bool) -> list[RequestOutput]:
+                        defer: bool, top_ids: Optional[np.ndarray] = None,
+                        top_lps: Optional[np.ndarray] = None,
+                        ) -> list[RequestOutput]:
         """next_tokens/logprobs: [B_pad, W]. Append window tokens per sequence
         until a stop condition fires; tokens generated past the stop are
         discarded.
@@ -935,14 +968,26 @@ class LLMEngine:
                 continue
             had_first = seq.first_token_time is not None
             want_lps = seq.params.logprobs
+            want_top = (seq.params.top_logprobs if top_ids is not None else 0)
             new_tokens: list[int] = []
             new_lps: list[float] = []
-            for token, lp in zip(next_tokens[s], logprobs[s]):
+            new_tops: list[list[tuple[int, float]]] = []
+            for j, (token, lp) in enumerate(zip(next_tokens[s], logprobs[s])):
                 token = int(token)
                 # Per-request gating: the device computes logprobs
                 # unconditionally (negligible next to sampling), but the
                 # host records them only for requests that asked.
-                seq.append_token(token, float(lp) if want_lps else None)
+                top = None
+                if want_top:
+                    top = [(int(t), float(v)) for t, v in
+                           zip(top_ids[s, j, :want_top],
+                               top_lps[s, j, :want_top])]
+                    # OpenAI/vLLM: the SAMPLED token is always present (up
+                    # to N+1 entries) even when it fell outside the top N.
+                    if token not in (t for t, _ in top):
+                        top.append((token, float(lp)))
+                    new_tops.append(top)
+                seq.append_token(token, float(lp) if want_lps else None, top)
                 new_tokens.append(token)
                 if want_lps:
                     new_lps.append(float(lp))
@@ -971,7 +1016,10 @@ class LLMEngine:
                 new_token_ids=new_tokens,
                 new_logprobs=new_lps if want_lps else None,
                 output_logprobs=(list(seq.output_logprobs)
-                                 if want_lps else None)))
+                                 if want_lps else None),
+                new_top_logprobs=new_tops if want_top else None,
+                output_top_logprobs=(list(seq.output_top_logprobs)
+                                     if seq.params.top_logprobs else None)))
         return outputs
 
     def _drain_terminally_finished(self) -> list[RequestOutput]:
@@ -990,7 +1038,9 @@ class LLMEngine:
                 finish_reason=seq.finish_reason.value if seq.finish_reason else None,
                 new_token_ids=[],
                 output_logprobs=(list(seq.output_logprobs)
-                                 if seq.params.logprobs else None)))
+                                 if seq.params.logprobs else None),
+                output_top_logprobs=(list(seq.output_top_logprobs)
+                                     if seq.params.top_logprobs else None)))
         self.scheduler.terminally_finished.clear()
         return outs
 

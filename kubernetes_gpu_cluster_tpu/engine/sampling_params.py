@@ -32,6 +32,8 @@ class SamplingParams:
     seed: Optional[int] = None      # reproducible sampling per request
     # OpenAI logit_bias: token id -> additive bias [-100, 100], <= 300 keys.
     logit_bias: Optional[dict] = None
+    # OpenAI completions logprobs=N alternatives (0..5); requires logprobs.
+    top_logprobs: int = 0
 
     def __post_init__(self):
         if self.max_tokens < 1:
@@ -48,6 +50,10 @@ class SamplingParams:
             raise ValueError("frequency_penalty must be in [-2, 2]")
         if self.seed is not None and not isinstance(self.seed, int):
             raise ValueError("seed must be an integer")
+        if not (0 <= self.top_logprobs <= 5):
+            raise ValueError("top_logprobs must be in [0, 5]")
+        if self.top_logprobs and not self.logprobs:
+            raise ValueError("top_logprobs requires logprobs")
         if self.logit_bias is not None:
             if not isinstance(self.logit_bias, dict):
                 raise ValueError("logit_bias must be a map of token id -> "

@@ -63,6 +63,7 @@ class ScheduledBatch:
     frequency: Optional[np.ndarray] = None
     seed: Optional[np.ndarray] = None      # -1 = unseeded
     prompt_lens: Optional[np.ndarray] = None  # output boundary (penalties)
+    top_n: Optional[np.ndarray] = None     # logprobs alternatives requested
 
     @property
     def num_seqs(self) -> int:
@@ -449,6 +450,7 @@ class Scheduler:
         frequency = np.zeros(B, np.float32)
         seed = np.full(B, -1, np.int32)
         prompt_lens = np.zeros(B, np.int32)
+        top_n = np.zeros(B, np.int32)
         for s, seq in enumerate(seqs):
             temperature[s] = seq.params.temperature
             top_k[s] = seq.params.top_k
@@ -456,10 +458,11 @@ class Scheduler:
             presence[s] = seq.params.presence_penalty
             frequency[s] = seq.params.frequency_penalty
             prompt_lens[s] = seq.num_prompt_tokens
+            top_n[s] = seq.params.top_logprobs
             if seq.params.seed is not None:
                 # OpenAI accepts any integer seed; the device key derivation
                 # wants a non-negative int32, so fold into 31 bits here.
                 seed[s] = seq.params.seed & 0x7fffffff
         return dict(temperature=temperature, top_k=top_k, top_p=top_p,
                     presence=presence, frequency=frequency, seed=seed,
-                    prompt_lens=prompt_lens)
+                    prompt_lens=prompt_lens, top_n=top_n)

@@ -32,6 +32,7 @@ class Sequence:
         self.prompt_token_ids = list(prompt_token_ids)
         self.output_token_ids: list[int] = []
         self.output_logprobs: list[float] = []
+        self.output_top_logprobs: list[list] = []   # [(token_id, lp) x N]
         self.params = params
         self.eos_token_id = eos_token_id
         self.status = SequenceStatus.WAITING
@@ -82,12 +83,15 @@ class Sequence:
         return self.status == SequenceStatus.FINISHED
 
     def append_token(self, token_id: int,
-                     logprob: Optional[float] = None) -> None:
+                     logprob: Optional[float] = None,
+                     top: Optional[list] = None) -> None:
         if self.first_token_time is None:
             self.first_token_time = time.monotonic()
         self.output_token_ids.append(token_id)
         if logprob is not None:
             self.output_logprobs.append(logprob)
+        if top is not None:
+            self.output_top_logprobs.append(top)
 
     def check_stop(self, max_model_len: int) -> Optional[FinishReason]:
         """Token-level stop conditions (string-level stops are handled by the

@@ -169,7 +169,8 @@ def sample_and_logprobs(
     top_k: jax.Array,         # [B] int32; 0 => disabled
     top_p: jax.Array,         # [B] float32; 1.0 => disabled
     row_keys: bool = False,
-) -> tuple[jax.Array, jax.Array]:
+    with_top=None,   # traced bool: also return TOP_LOGPROBS alternatives
+) -> tuple[jax.Array, ...]:
     """Returns (sampled token ids [B] int32, chosen-token logprobs [B] f32).
     Greedy rows (temperature==0) ignore the random draw entirely and report
     logprobs of the raw distribution; sampled rows report logprobs under the
@@ -197,11 +198,17 @@ def sample_and_logprobs(
         else:
             ids = jax.random.categorical(key, filtered, axis=-1)
         ids = jnp.where(temperature <= 0, greedy_ids, ids.astype(jnp.int32))
-        return ids, _chosen_logprobs(scaled, ids)
+        out = (ids, _chosen_logprobs(scaled, ids))
+        return (out + gated_top_logprobs(scaled, with_top)
+                if with_top is not None else out)
 
-    return jax.lax.cond(
-        jnp.any(temperature > 0), sampled_path,
-        lambda _: (greedy_ids, _chosen_logprobs(logits, greedy_ids)), None)
+    def greedy_path(_):
+        out = (greedy_ids, _chosen_logprobs(logits, greedy_ids))
+        return (out + gated_top_logprobs(logits, with_top)
+                if with_top is not None else out)
+
+    return jax.lax.cond(jnp.any(temperature > 0), sampled_path, greedy_path,
+                        None)
 
 
 def sample_tokens(
@@ -227,6 +234,33 @@ def _chosen_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     chosen = jnp.take_along_axis(shifted, tokens[:, None].astype(jnp.int32),
                                  axis=-1)[:, 0]
     return chosen - lse
+
+
+# OpenAI completions expose at most 5 top-alternative logprobs per token;
+# every step program computes this many unconditionally (a [B, V] top-5 is
+# cheap next to the forward pass) and the HOST fetches them only when some
+# request asked (the device->host transfer is the real cost).
+TOP_LOGPROBS = 5
+
+
+def top_logprobs(logits: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(ids [B, TOP_LOGPROBS] i32, logprobs [B, TOP_LOGPROBS] f32) of the
+    most likely tokens under log-softmax(logits). Pass temperature-scaled
+    logits to match the distribution the token was sampled from."""
+    lps = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    vals, ids = jax.lax.top_k(lps, TOP_LOGPROBS)
+    return ids.astype(jnp.int32), vals
+
+
+def gated_top_logprobs(logits: jax.Array, want) -> tuple[jax.Array, jax.Array]:
+    """top_logprobs under a runtime cond: batches where no request asked
+    for alternatives (the common case, and the bench) skip the [B, V]
+    top-k entirely and emit zero-fills the host never fetches."""
+    B = logits.shape[0]
+    return jax.lax.cond(
+        want, top_logprobs,
+        lambda l: (jnp.zeros((B, TOP_LOGPROBS), jnp.int32),
+                   jnp.zeros((B, TOP_LOGPROBS), jnp.float32)), logits)
 
 
 def token_logprobs(logits: jax.Array, tokens: jax.Array,

@@ -36,7 +36,8 @@ from .tokenizer import (IncrementalDetokenizer, Tokenizer,
 logger = get_logger("serving.api")
 
 
-def _sampling_params(body: dict, eos_token_id: Optional[int]) -> SamplingParams:
+def _sampling_params(body: dict, eos_token_id: Optional[int],
+                     n_logprobs: int = 0) -> SamplingParams:
     seed = body.get("seed")
     return SamplingParams(
         max_tokens=int(body.get("max_tokens") or 256),
@@ -45,7 +46,10 @@ def _sampling_params(body: dict, eos_token_id: Optional[int]) -> SamplingParams:
         top_k=int(body.get("top_k", 0)),
         stop_token_ids=tuple([eos_token_id] if eos_token_id is not None else [])
         + tuple(body.get("stop_token_ids") or ()),
-        logprobs=bool(body.get("logprobs")),
+        logprobs=n_logprobs >= 1,
+        # OpenAI: logprobs=N returns top-N alternatives for every N >= 1
+        # (plus the sampled token; True maps to N=1).
+        top_logprobs=max(n_logprobs, 0),
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
         seed=int(seed) if seed is not None else None,
@@ -54,24 +58,22 @@ def _sampling_params(body: dict, eos_token_id: Optional[int]) -> SamplingParams:
 
 
 def _logprobs_requested(body: dict):
-    """OpenAI completions ``logprobs``: null/0/false => off; 1/true =>
-    chosen-token logprobs. Alternatives (top-k > 1) are not supported —
-    only the sampled token's logprob leaves the device."""
+    """OpenAI completions ``logprobs``: null/0/false => off; N in 1..5 (or
+    true => 1) => chosen-token logprobs plus the N most likely tokens per
+    position (``top_logprobs`` dicts, computed on-device; the sampled token
+    is always included, so up to N+1 entries). Returns (n, error)."""
     lp = body.get("logprobs")
     if lp is None or lp is False:
-        return False, None
+        return 0, None
     if lp is True:
-        return True, None
+        return 1, None
     if isinstance(lp, float) and lp.is_integer():
         lp = int(lp)   # json floats: 1.0 and 1 are the same request
     if not isinstance(lp, int):
-        return False, _error(400, "logprobs must be a boolean or an integer")
-    if lp == 0:
-        return False, None
-    if lp == 1:
-        return True, None
-    return False, _error(400, "logprobs > 1 (top alternatives) is not "
-                              "supported; use logprobs: 1")
+        return 0, _error(400, "logprobs must be a boolean or an integer")
+    if not (0 <= lp <= 5):
+        return 0, _error(400, "logprobs must be in [0, 5] (OpenAI cap)")
+    return lp, None
 
 
 def _stops(body: dict) -> list[str]:
@@ -201,9 +203,10 @@ class APIServer:
 
     async def _run(self, request: web.Request, body: dict, ids: list[int],
                    kind: str) -> web.StreamResponse:
-        want_lps, lp_err = _logprobs_requested(body)
+        n_lp, lp_err = _logprobs_requested(body)
         if lp_err is not None:
             return lp_err
+        want_lps = n_lp >= 1
         if want_lps and kind != "completion":
             return _error(400, "logprobs are supported on /v1/completions "
                                "only")
@@ -216,7 +219,8 @@ class APIServer:
         # to the whole prompt; documented in PARITY.md.
         echo_prefix = self.tokenizer.decode(ids) if echo else ""
         try:
-            params = _sampling_params(body, self.tokenizer.eos_token_id)
+            params = _sampling_params(body, self.tokenizer.eos_token_id,
+                                      n_logprobs=n_lp)
         except (TypeError, ValueError) as e:
             return _error(400, str(e))
         detok = IncrementalDetokenizer(self.tokenizer, stop=_stops(body))
@@ -245,7 +249,7 @@ class APIServer:
                                    "supported")
             return await self._run_n(body, ids, params, kind, rid, created,
                                      n, want_lps, echo_prefix,
-                                     best_of=best_of)
+                                     best_of=best_of, n_lp=n_lp)
         self.metrics.on_request()
 
         # ``complete`` guards the engine-side abort: any early handler exit —
@@ -257,8 +261,8 @@ class APIServer:
         complete = False
         if not stream:
             try:
-                (text, finish_reason, n_out, tok_ids,
-                 tok_lps) = await self._collect(gen, detok, rid)
+                (text, finish_reason, n_out, tok_ids, tok_lps,
+                 tok_tops) = await self._collect(gen, detok, rid)
                 complete = True
             except ValueError as e:
                 complete = True      # engine already rejected/finished it
@@ -273,10 +277,11 @@ class APIServer:
                 if want_lps:
                     tok_ids = list(ids) + tok_ids
                     tok_lps = [None] * len(ids) + tok_lps
+                    tok_tops = [None] * len(ids) + tok_tops
             return web.json_response(_response_envelope(
                 kind, rid, created, self.model_name,
                 [_choice(kind, 0, text, finish_reason, self.tokenizer,
-                         tok_ids, tok_lps, want_lps)],
+                         tok_ids, tok_lps, want_lps, tok_tops, n_lp)],
                 prompt_tokens=len(ids), completion_tokens=n_out))
 
         resp = web.StreamResponse(headers={
@@ -314,6 +319,10 @@ class APIServer:
                                        for t in chunk.new_token_ids],
                             "token_logprobs": list(chunk.new_logprobs),
                         }
+                        if chunk.new_top_logprobs:
+                            sb["choices"][0]["logprobs"]["top_logprobs"] = \
+                                _format_tops(self.tokenizer,
+                                             chunk.new_top_logprobs)
                     await resp.write(_sse(sb))
                 if finished:
                     complete = True
@@ -330,7 +339,8 @@ class APIServer:
         return resp
 
     async def _run_n(self, body, ids, params, kind, rid, created, n,
-                     want_lps, echo_prefix="", best_of=None) -> web.Response:
+                     want_lps, echo_prefix="", best_of=None,
+                     n_lp=0) -> web.Response:
         """OpenAI ``n`` > 1 / ``best_of``: best_of engine requests for one
         prompt, gathered concurrently (with prefix caching enabled the
         duplicates reuse the prompt's KV pages); when best_of > n, choices
@@ -395,19 +405,22 @@ class APIServer:
             discarded_out = sum(r[2] for r in results[n:])
             results = results[:n]
             if not params.logprobs:       # ranking-only logprobs: strip
-                results = [(t, fr, no, ti, []) for t, fr, no, ti, _ in results]
+                results = [(t, fr, no, ti, [], tt)
+                           for t, fr, no, ti, _, tt in results]
         choices = []
         total_out = discarded_out
-        for i, (text, finish_reason, n_out, tok_ids, tok_lps) in enumerate(results):
+        for i, (text, finish_reason, n_out, tok_ids, tok_lps,
+                tok_tops) in enumerate(results):
             total_out += n_out
             if echo_prefix:
                 text = echo_prefix + text
                 if want_lps:
                     tok_ids = list(ids) + tok_ids
                     tok_lps = [None] * len(ids) + tok_lps
+                    tok_tops = [None] * len(ids) + tok_tops
             choices.append(_choice(kind, i, text, finish_reason,
                                    self.tokenizer, tok_ids, tok_lps,
-                                   want_lps))
+                                   want_lps, tok_tops, n_lp))
         self.metrics.on_finish(total_out)
         return web.json_response(_response_envelope(
             kind, rid, created, self.model_name, choices,
@@ -419,6 +432,7 @@ class APIServer:
         n_out = 0
         tok_ids: list[int] = []
         tok_lps: list[float] = []
+        tok_tops: list = []
         async for chunk in gen:
             n_out = len(chunk.output_token_ids)
             text.append(detok.push(chunk.new_token_ids, final=chunk.finished))
@@ -433,9 +447,11 @@ class APIServer:
                 break
             tok_ids.extend(chunk.new_token_ids)
             tok_lps.extend(chunk.new_logprobs or [])
+            tok_tops.extend(chunk.new_top_logprobs or [])
             if chunk.finished:
                 finish_reason = _map_reason(chunk.finish_reason)
-        return "".join(text), finish_reason, n_out, tok_ids, tok_lps
+        return ("".join(text), finish_reason, n_out, tok_ids, tok_lps,
+                tok_tops)
 
 
 # -- OpenAI wire formats ----------------------------------------------------
@@ -445,8 +461,28 @@ def _map_reason(reason: Optional[str]) -> Optional[str]:
             "abort": "abort"}.get(reason or "", reason)
 
 
+def _format_tops(tokenizer, tops) -> list:
+    """[(id, lp) x N] per position -> OpenAI top_logprobs dicts
+    ({token_str: lp}); None entries (echoed prompt positions) pass through.
+    Distinct ids can decode to the same string — keep the BEST logprob per
+    string (a naive dict comprehension would let a worse later entry
+    overwrite the top-1)."""
+    out = []
+    for t in tops:
+        if t is None:
+            out.append(None)
+            continue
+        d: dict[str, float] = {}
+        for tid, lp in t:
+            s = tokenizer.decode([tid])
+            if s not in d or lp > d[s]:
+                d[s] = lp
+        out.append(d)
+    return out
+
+
 def _choice(kind, index, text, finish_reason, tokenizer, tok_ids, tok_lps,
-            want_lps) -> dict:
+            want_lps, tok_tops=None, n_lp=0) -> dict:
     choice: dict[str, Any] = {"index": index, "finish_reason": finish_reason}
     if kind == "completion":
         choice["text"] = text
@@ -455,6 +491,9 @@ def _choice(kind, index, text, finish_reason, tokenizer, tok_ids, tok_lps,
                 "tokens": [tokenizer.decode([t]) for t in tok_ids],
                 "token_logprobs": tok_lps,
             }
+            if n_lp >= 1:
+                choice["logprobs"]["top_logprobs"] = _format_tops(
+                    tokenizer, tok_tops or [])
     else:
         choice["message"] = {"role": "assistant", "content": text}
     return choice

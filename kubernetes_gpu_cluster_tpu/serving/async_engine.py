@@ -36,6 +36,7 @@ class StreamChunk:
     finished: bool
     finish_reason: Optional[str]
     new_logprobs: list[float] = dataclasses.field(default_factory=list)
+    new_top_logprobs: list = dataclasses.field(default_factory=list)
 
 
 class AsyncLLMEngine:
@@ -177,4 +178,5 @@ def _chunk_of(out: RequestOutput) -> StreamChunk:
         output_token_ids=list(out.output_token_ids),
         finished=out.finished,
         finish_reason=out.finish_reason,
-        new_logprobs=list(out.new_logprobs or []))
+        new_logprobs=list(out.new_logprobs or []),
+        new_top_logprobs=list(out.new_top_logprobs or []))

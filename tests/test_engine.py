@@ -207,6 +207,31 @@ def test_logit_bias_forces_and_bans_tokens():
             logit_bias={10 ** 6: -100.0}))
 
 
+def test_top_logprobs_alternatives():
+    """logprobs=N alternatives: the top list contains the chosen token for
+    greedy rows (argmax == top-1), logprobs are sorted descending, and the
+    record spans prefill + chained decode windows."""
+    import math
+    eng = make_engine()
+    out = eng.generate([[3, 1, 4]], SamplingParams(
+        max_tokens=6, temperature=0.0, logprobs=True, top_logprobs=3))[0]
+    tops = out.output_top_logprobs
+    assert len(tops) == 6
+    for token, top, lp in zip(out.output_token_ids, tops,
+                              out.output_logprobs):
+        assert len(top) == 3
+        ids = [t for t, _ in top]
+        lps = [v for _, v in top]
+        assert token == ids[0]          # greedy chose the argmax
+        assert lps == sorted(lps, reverse=True)
+        assert math.isclose(lps[0], lp, rel_tol=1e-5)
+
+    with pytest.raises(ValueError):
+        SamplingParams(top_logprobs=6)
+    with pytest.raises(ValueError):
+        SamplingParams(top_logprobs=2)   # requires logprobs
+
+
 def test_logit_bias_validation():
     with pytest.raises(ValueError):
         SamplingParams(logit_bias=[1, 2])
