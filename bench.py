@@ -33,6 +33,7 @@ from __future__ import annotations
 import gc
 import json
 import os
+import sys
 import time
 
 import jax
@@ -359,6 +360,13 @@ def run_config(model_name: str, quant, batch: int, *, sustained: bool,
     mcfg = engine.config.model
     acct = _roofline(mcfg, quant, batch, ctx_mid)
     util = _utilization(acct, greedy_rate, batch)
+    # Observability readout: median queue/prefill/first-fetch TTFT split and
+    # the per-phase step-time attribution accumulated over the whole run —
+    # a TTFT or tok/s regression in a future round decomposes into a phase
+    # delta instead of a guess.
+    ttft_decomp = engine.obs.ttft_decomposition()
+    phase_breakdown = engine.obs.phases.breakdown()
+    sampled_ratio = engine.obs.sampled_decode_ratio()
     result = {
         "model": model_name,
         "quantization": quant,
@@ -371,7 +379,17 @@ def run_config(model_name: str, quant, batch: int, *, sustained: bool,
                                           else None),
         "sampled_over_greedy": (round(sampled_rate / greedy_rate, 3)
                                 if sampled_rate == sampled_rate else None),
+        # Engine-side counterpart of sampled_over_greedy, accumulated over
+        # ALL decode steps of the run INCLUDING the sampled program's compile
+        # window (so it reads low here; in a long-running server, where
+        # compiles amortize to nothing, the kgct_sampled_decode_ratio gauge
+        # converges on the true ratio). The regression guard is
+        # sampled_over_greedy above, measured post-warmup.
+        "sampled_decode_ratio_obs": (round(sampled_ratio, 3)
+                                     if sampled_ratio is not None else None),
         **prefill,
+        "ttft_decomposition": ttft_decomp,
+        "step_phase_breakdown": phase_breakdown,
         "roofline": {
             "chip": {"hbm_gbps_peak": CHIP_HBM_GBPS,
                      "tflops_bf16_peak": CHIP_TFLOPS_BF16},
@@ -383,11 +401,67 @@ def run_config(model_name: str, quant, batch: int, *, sustained: bool,
     }
     if sustained and greedy_rate > 0:
         rate_rps = LOAD_UTILIZATION * greedy_rate / LOAD_MAX_NEW
+        # Reset the decomposition deques so the sustained phase's split is
+        # not diluted by fresh-batch samples — under load, queue wait is the
+        # north-star suspect and must be attributed on its own.
+        for dq in (engine.obs.ttft_queue_s, engine.obs.ttft_prefill_s,
+                   engine.obs.ttft_fetch_s):
+            dq.clear()
         result["sustained_load"] = _measure_sustained(
             engine, rng, vocab, batch, rate_rps)
+        result["sustained_load"]["ttft_decomposition"] = (
+            engine.obs.ttft_decomposition())
     del engine
     gc.collect()
     return result
+
+
+def assemble_output(results: list[dict], backend: str) -> dict:
+    """Fold per-config results into the single driver-facing JSON object.
+
+    Pure (no I/O) so tests can round-trip it through ``json.loads`` — r5's
+    official record has ``"parsed": null`` because the result line never made
+    it through the driver's parser; the assembly and the emission are now
+    separately guaranteed (see ``emit_result``)."""
+    primary = results[-1]
+    bar = A100_VLLM_TOKS_PER_S.get(primary["model"])
+    return {
+        "metric": (f"decode_tokens_per_sec_per_chip[{primary['model']}"
+                   f"{',' + primary['quantization'] if primary['quantization'] else ''}"
+                   f",B={primary['batch']},ctx={PROMPT_LEN}]"),
+        "value": primary["decode_tokens_per_sec"],
+        "unit": "tokens/s/chip",
+        "vs_baseline": (round(primary["decode_tokens_per_sec"] / bar, 3)
+                        if bar else None),
+        "backend": backend,
+        # vs_baseline is normalized against a SELF-CHOSEN constant (the
+        # reference publishes no numbers): representative single-A100 vLLM
+        # decode throughput for this model class.
+        "baseline_bar": {"value": bar,
+                         "source": ("chosen constant (A100 vLLM class bar)"
+                                    if bar else "no bar defined for model")},
+        "decode_window": primary["decode_window"],
+        "prefill_budget": primary["prefill_budget"],
+        # The primary config's TTFT decomposition (queue / prefill /
+        # first-step fetch medians) surfaced top-level for the driver.
+        "ttft_decomposition": primary.get("ttft_decomposition"),
+        "sampled_over_greedy": primary.get("sampled_over_greedy"),
+        "configs": results,
+    }
+
+
+def emit_result(out: dict) -> None:
+    """Emit the result as the GUARANTEED last stdout line: json.dumps with
+    no embedded newlines, everything previously buffered flushed first, one
+    write, one flush. All framework logging already goes to stderr
+    (utils/logging.py); anything a library printed earlier is flushed ahead
+    of the result so interleaving cannot split the line."""
+    line = json.dumps(out)
+    assert "\n" not in line
+    sys.stderr.flush()
+    sys.stdout.flush()
+    sys.stdout.write(line + "\n")
+    sys.stdout.flush()
 
 
 def main() -> None:
@@ -434,29 +508,7 @@ def main() -> None:
 
     host_rt_s = _measure_host_rt_s()
     results = [run_config(host_rt_s=host_rt_s, rng=rng, **c) for c in configs]
-
-    primary = results[-1]
-    bar = A100_VLLM_TOKS_PER_S.get(primary["model"])
-    out = {
-        "metric": (f"decode_tokens_per_sec_per_chip[{primary['model']}"
-                   f"{',' + primary['quantization'] if primary['quantization'] else ''}"
-                   f",B={primary['batch']},ctx={PROMPT_LEN}]"),
-        "value": primary["decode_tokens_per_sec"],
-        "unit": "tokens/s/chip",
-        "vs_baseline": (round(primary["decode_tokens_per_sec"] / bar, 3)
-                        if bar else None),
-        "backend": backend,
-        # vs_baseline is normalized against a SELF-CHOSEN constant (the
-        # reference publishes no numbers): representative single-A100 vLLM
-        # decode throughput for this model class.
-        "baseline_bar": {"value": bar,
-                         "source": ("chosen constant (A100 vLLM class bar)"
-                                    if bar else "no bar defined for model")},
-        "decode_window": primary["decode_window"],
-        "prefill_budget": primary["prefill_budget"],
-        "configs": results,
-    }
-    print(json.dumps(out))
+    emit_result(assemble_output(results, backend))
 
 
 if __name__ == "__main__":

@@ -34,7 +34,7 @@ import yaml
 from .render import render_values
 
 CHART_NAME = "kgct-stack"
-CHART_VERSION = "0.3.0"
+CHART_VERSION = "0.4.0"
 
 
 def _escape_go_template(text: str) -> str:

@@ -69,6 +69,18 @@ def _labels(name: str, component: str) -> dict:
     }
 
 
+def _scrape_annotations(port: int) -> dict:
+    """prometheus.io discovery annotations: every rendered serving pod
+    exposes /metrics (engine histograms / router per-replica aggregation),
+    so a stock Prometheus with the standard annotation-based kubernetes_sd
+    relabeling scrapes the whole stack with zero extra config."""
+    return {
+        "prometheus.io/scrape": "true",
+        "prometheus.io/port": str(port),
+        "prometheus.io/path": "/metrics",
+    }
+
+
 def _engine_args(spec: dict) -> list[str]:
     cfg = spec.get("vllmConfig") or {}
     args = ["--model", str(spec["modelURL"]),
@@ -177,7 +189,8 @@ def _render_model(spec: dict, engine: dict) -> dict[str, dict]:
     labels = _labels(name, "serving-engine")
     sel = {"matchLabels": labels}
     meta = {"name": f"kgct-{name}-engine", "labels": labels}
-    pod = {"metadata": {"labels": labels},
+    pod = {"metadata": {"labels": labels,
+                        "annotations": _scrape_annotations(ENGINE_PORT)},
            "spec": _pod_spec(spec, engine, multihost)}
     out: dict[str, dict] = {}
 
@@ -259,6 +272,14 @@ def _render_router(model_names: list[str], router_spec: dict) -> dict[str, dict]
                 "replicas": router_spec.get("replicaCount", 1),
                 "selector": {"matchLabels": labels},
                 "template": {
+                    # NO scrape annotations here: the router's /metrics
+                    # re-exports every healthy engine's series (replica-
+                    # labeled), so scraping it alongside the annotated
+                    # engine pods would double-ingest each sample and
+                    # double every sum()/rate() across the stack. The
+                    # router is the scrape target for setups that cannot
+                    # reach pod IPs; annotation-based discovery uses the
+                    # engine pods directly.
                     "metadata": {"labels": labels},
                     "spec": {"containers": [{
                         "name": "router",

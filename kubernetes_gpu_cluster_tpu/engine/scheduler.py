@@ -27,6 +27,7 @@ from typing import Optional
 import numpy as np
 
 from ..config import EngineConfig
+from ..observability import Observability
 from ..utils import cdiv, get_logger
 from ..utils.math import next_power_of_2
 from .kv_cache import CachingPageAllocator, PageAllocator
@@ -78,7 +79,13 @@ def _bucket(value: int, buckets: tuple[int, ...]) -> int:
 
 
 class Scheduler:
-    def __init__(self, config: EngineConfig, num_pages: int):
+    def __init__(self, config: EngineConfig, num_pages: int,
+                 obs: Optional[Observability] = None):
+        # The engine shares its Observability so scheduler-side lifecycle
+        # events (queued/scheduled/chunk/preempt/terminal) land in the same
+        # trace ring as the step loop's; standalone construction (tests)
+        # gets a private one.
+        self.obs = obs if obs is not None else Observability()
         self.config = config
         sc = config.scheduler
         self.max_num_seqs = sc.max_num_seqs
@@ -120,6 +127,7 @@ class Scheduler:
             raise ValueError(
                 f"prompt needs {need} KV pages but the pool has {usable_pages}")
         self.waiting.append(seq)
+        self.obs.on_queued(seq, depth=len(self.waiting))
 
     def abort(self, request_id: str) -> bool:
         for seq in list(self.waiting):
@@ -128,6 +136,7 @@ class Scheduler:
                 seq.status = SequenceStatus.FINISHED
                 seq.finish_reason = FinishReason.ABORT
                 self._release(seq)   # mid-chunk prefills hold pages
+                self.obs.on_finish(seq, FinishReason.ABORT)
                 return True
         for seq in self.running:
             if seq.request_id == request_id:
@@ -135,6 +144,7 @@ class Scheduler:
                 seq.status = SequenceStatus.FINISHED
                 seq.finish_reason = FinishReason.ABORT
                 self._release(seq)
+                self.obs.on_finish(seq, FinishReason.ABORT)
                 return True
         return False
 
@@ -152,6 +162,7 @@ class Scheduler:
         self._release(seq)
         if seq in self.running:
             self.running.remove(seq)
+        self.obs.on_finish(seq, reason)
 
     def _preempt_youngest(self) -> bool:
         """Evict the most recently admitted running sequence (recompute-style
@@ -175,8 +186,10 @@ class Scheduler:
         else:
             self.waiting.appendleft(victim)
         self.num_preemptions += 1
+        self.obs.on_preempt(victim)
         logger.warning("preempted %s (KV pages exhausted; free=%d)",
-                       victim.request_id, self.allocator.num_free)
+                       victim.request_id, self.allocator.num_free,
+                       extra={"request_id": victim.request_id})
         return True
 
     # -- scheduling ---------------------------------------------------------
@@ -238,6 +251,7 @@ class Scheduler:
                 seq.status = SequenceStatus.FINISHED
                 seq.finish_reason = FinishReason.LENGTH
                 self.terminally_finished.append(seq)
+                self.obs.on_finish(seq, FinishReason.LENGTH)
                 logger.warning(
                     "%s needs %d pages > pool capacity %d; finishing at "
                     "length %d", seq.request_id, need,
@@ -280,6 +294,7 @@ class Scheduler:
             logits_indices[s] = i - 1
             seq.status = SequenceStatus.RUNNING
             self.running.append(seq)
+            self.obs.on_scheduled(seq, len(admitted))
 
         return ScheduledBatch(
             kind="prefill", seqs=admitted, tokens=tokens, positions=positions,
@@ -307,8 +322,10 @@ class Scheduler:
                 seq.status = SequenceStatus.FINISHED
                 seq.finish_reason = FinishReason.LENGTH
                 self.terminally_finished.append(seq)
+                self.obs.on_finish(seq, FinishReason.LENGTH)
                 logger.warning("%s chunked prefill exceeds pool capacity "
-                               "(%d pages); finishing", seq.request_id, usable)
+                               "(%d pages); finishing", seq.request_id, usable,
+                               extra={"request_id": seq.request_id})
             return None        # wait for decode finishes to free pages
         if need > 0:
             seq.pages.extend(self.allocator.allocate(need))
@@ -340,9 +357,17 @@ class Scheduler:
 
         hist_len = seq.num_prefilled
         seq.num_prefilled = end
+        if seq.scheduled_time is None or (
+                seq.status == SequenceStatus.PREEMPTED and hist_len == 0):
+            # Queue wait ends at the FIRST chunk's scheduling (later chunks
+            # are prefill progress, not queueing); a preempted readmission's
+            # first recompute chunk emits its "resume" event here.
+            self.obs.on_scheduled(seq, 1)
+        self.obs.on_prefill_chunk(seq, hist_len, end, seq.num_tokens)
         if partial:
             logger.info("%s prefill chunk [%d:%d) of %d", seq.request_id,
-                        hist_len, end, seq.num_tokens)
+                        hist_len, end, seq.num_tokens,
+                        extra={"request_id": seq.request_id})
         else:
             self.waiting.popleft()
             seq.status = SequenceStatus.RUNNING

@@ -40,6 +40,13 @@ class Sequence:
         self.pages: list[int] = []
         self.arrival_time = time.monotonic()
         self.first_token_time: Optional[float] = None  # for TTFT metrics
+        # Lifecycle timestamps/counters for the observability layer: first
+        # scheduling (queue-wait), terminal time (e2e latency; also the
+        # idempotence guard for Observability.on_finish), preemption count
+        # (outcome labeling + preempt/resume trace events).
+        self.scheduled_time: Optional[float] = None
+        self.finish_time: Optional[float] = None
+        self.preempt_count = 0
         # Chunked prefill progress: tokens whose KV is already committed to
         # the pool by earlier chunks. Reset on preemption (pages are freed,
         # the prompt recomputes from scratch).

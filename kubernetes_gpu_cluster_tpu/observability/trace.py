@@ -1,0 +1,132 @@
+"""Request-lifecycle tracer: bounded ring of typed events, Perfetto export.
+
+Every request's path through the engine — arrival, queueing, scheduling,
+prefill chunks, first token, preemption/resume, finish/abort — is recorded
+as a timestamped event in a fixed-size ring buffer. The ring is the whole
+memory story: O(capacity) regardless of uptime, oldest events dropped first,
+append is one deque.append on the step-loop thread (no locks — CPython's
+deque append is atomic, and the exporter snapshots with list()).
+
+Export is Chrome/Perfetto trace-event JSON (``GET /debug/trace``): each
+request becomes an async span (``ph: b/n/e`` keyed by request id) on the
+"requests" track, and each engine step's phase timings (phases.py) become
+complete slices (``ph: X``) on the "engine.step" track — load the file in
+https://ui.perfetto.dev and TTFT decomposes visually into queue wait,
+prefill, and fetch.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+# Typed event kinds (the request lifecycle, in rough order).
+EVENT_KINDS = ("arrival", "queued", "scheduled", "prefill_chunk",
+               "first_token", "decode", "preempt", "resume",
+               "finish", "abort")
+
+# Events that OPEN / CLOSE a request's async span in the Perfetto export.
+_OPEN = "arrival"
+_CLOSE = ("finish", "abort")
+
+
+class TraceEvent:
+    __slots__ = ("ts", "kind", "request_id", "args")
+
+    def __init__(self, ts: float, kind: str, request_id: str, args: dict):
+        self.ts = ts
+        self.kind = kind
+        self.request_id = request_id
+        self.args = args
+
+    def as_dict(self) -> dict:
+        return {"ts": self.ts, "kind": self.kind,
+                "request_id": self.request_id, **self.args}
+
+
+class RequestTracer:
+    def __init__(self, capacity: int = 8192, enabled: bool = True):
+        self.enabled = enabled
+        self._ring: deque[TraceEvent] = deque(maxlen=capacity)
+        # Engine-wide events (empty request id — one "decode" instant per
+        # step window) get their own ring: sustained decode emits hundreds
+        # per second and must never evict the request-lifecycle events the
+        # TTFT/queue-wait attribution exists to keep.
+        self._step_ring: deque[TraceEvent] = deque(maxlen=capacity // 4)
+
+    def emit(self, kind: str, request_id: str = "", **args) -> None:
+        if not self.enabled:
+            return
+        ring = self._ring if request_id else self._step_ring
+        ring.append(TraceEvent(time.monotonic(), kind, request_id, args))
+
+    def events(self) -> list[TraceEvent]:
+        return sorted([*self._ring, *self._step_ring], key=lambda e: e.ts)
+
+    def clear(self) -> None:
+        self._ring.clear()
+        self._step_ring.clear()
+
+    # -- export --------------------------------------------------------------
+
+    def export_perfetto(self, step_records: Optional[list] = None) -> dict:
+        """Chrome trace-event JSON. ``step_records``: phases.StepPhaseStats
+        records to render as engine.step phase slices alongside the request
+        spans. Timestamps are µs relative to the earliest event so the trace
+        opens at t=0 in the viewer."""
+        events = self.events()
+        records = list(step_records or [])
+        t0_candidates = [e.ts for e in events]
+        t0_candidates += [ph[1] for r in records for ph in r["phases"]]
+        t0 = min(t0_candidates) if t0_candidates else 0.0
+
+        def us(ts: float) -> float:
+            return round((ts - t0) * 1e6, 1)
+
+        trace_events = [
+            {"name": "process_name", "ph": "M", "pid": 1,
+             "args": {"name": "kgct-engine"}},
+            {"name": "thread_name", "ph": "M", "pid": 1, "tid": 1,
+             "args": {"name": "requests"}},
+            {"name": "thread_name", "ph": "M", "pid": 1, "tid": 2,
+             "args": {"name": "engine.step"}},
+        ]
+        open_ids: set[str] = set()
+        for e in events:
+            if not e.request_id:
+                # Engine-wide event (e.g. per-window "decode"): an instant on
+                # the step track.
+                trace_events.append(
+                    {"name": e.kind, "cat": "engine", "ph": "i", "s": "t",
+                     "pid": 1, "tid": 2, "ts": us(e.ts), "args": e.args})
+                continue
+            common = {"cat": "request", "id": e.request_id, "pid": 1,
+                      "tid": 1, "ts": us(e.ts)}
+            if e.kind == _OPEN:
+                open_ids.add(e.request_id)
+                trace_events.append(
+                    {"name": e.request_id, "ph": "b", **common,
+                     "args": e.args})
+            elif e.kind in _CLOSE:
+                if e.request_id not in open_ids:
+                    # Arrival fell off the ring: synthesize a zero-length
+                    # open so the close still pairs (Perfetto drops orphans).
+                    trace_events.append(
+                        {"name": e.request_id, "ph": "b", **common,
+                         "args": {"truncated": True}})
+                open_ids.discard(e.request_id)
+                trace_events.append(
+                    {"name": e.request_id, "ph": "e", **common,
+                     "args": {"event": e.kind, **e.args}})
+            else:
+                trace_events.append(
+                    {"name": e.kind, "ph": "n", **common, "args": e.args})
+        for rec in records:
+            for name, start, dur in rec["phases"]:
+                trace_events.append(
+                    {"name": name, "cat": "step", "ph": "X", "pid": 1,
+                     "tid": 2, "ts": us(start), "dur": round(dur * 1e6, 1),
+                     "args": {"step": rec["step"], "kind": rec["kind"],
+                              "batch": rec["batch"]}})
+        return {"traceEvents": trace_events, "displayTimeUnit": "ms"}

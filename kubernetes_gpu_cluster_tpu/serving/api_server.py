@@ -9,6 +9,9 @@ The reference's user-facing contract: an OpenAI API served behind
 - ``GET  /v1/models``             the model card the router aggregates
 - ``GET  /health``                liveness + engine queue depth
 - ``GET  /metrics``               Prometheus text format (serving.metrics)
+- ``GET  /debug/trace``           request-lifecycle + step-phase trace
+                                  (Chrome/Perfetto trace-event JSON)
+- ``POST /debug/profile``         jax.profiler capture of live traffic
 
 Stop semantics: stop TOKEN ids fire inside the engine; stop STRINGS are
 evaluated here on incrementally detokenized text (IncrementalDetokenizer
@@ -102,6 +105,7 @@ class APIServer:
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.prometheus)
+        app.router.add_get("/debug/trace", self.trace)
         app.router.add_post("/debug/profile", self.profile)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
@@ -125,6 +129,29 @@ class APIServer:
     async def prometheus(self, request: web.Request) -> web.Response:
         return web.Response(text=self.metrics.render(),
                             content_type="text/plain")
+
+    async def trace(self, request: web.Request) -> web.Response:
+        """Export the engine's request-lifecycle trace ring + step-phase
+        slices as Chrome/Perfetto trace-event JSON — download and load into
+        https://ui.perfetto.dev to see each request's queue/prefill/decode
+        span against the engine step phases. ``?clear=1`` empties the ring
+        after export (scoped captures around a load test)."""
+        obs = self.engine.engine.obs
+        data = obs.export_perfetto()
+        if request.query.get("clear") in ("1", "true"):
+            obs.clear_trace()
+        return web.json_response(data)
+
+    def _detok_push(self, detok: IncrementalDetokenizer, ids, final) -> str:
+        """detok.push with its wall time attributed to the ``detokenize``
+        phase — host-side text assembly is a real TTFT/latency contributor
+        the engine's step loop cannot see (it owns no tokenizer)."""
+        t0 = time.perf_counter()
+        try:
+            return detok.push(ids, final=final)
+        finally:
+            self.engine.engine.obs.phases.record(
+                "detokenize", time.perf_counter() - t0)
 
     async def profile(self, request: web.Request) -> web.Response:
         """Capture a jax.profiler trace of live serving traffic.
@@ -295,7 +322,8 @@ class APIServer:
         try:
             async for chunk in gen:
                 n_out = len(chunk.output_token_ids)
-                delta = detok.push(chunk.new_token_ids, final=chunk.finished)
+                delta = self._detok_push(detok, chunk.new_token_ids,
+                                         chunk.finished)
                 finished = chunk.finished or detok.stopped
                 if detok.stopped and not chunk.finished:
                     self.engine.abort(rid)
@@ -435,7 +463,8 @@ class APIServer:
         tok_tops: list = []
         async for chunk in gen:
             n_out = len(chunk.output_token_ids)
-            text.append(detok.push(chunk.new_token_ids, final=chunk.finished))
+            text.append(self._detok_push(detok, chunk.new_token_ids,
+                                         chunk.finished))
             if detok.stopped:
                 # The chunk containing the stop match is excluded from the
                 # logprobs record: its trailing tokens are not represented

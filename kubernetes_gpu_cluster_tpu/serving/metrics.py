@@ -3,12 +3,17 @@
 The reference had NO metrics surface at all — observability was kubectl
 transcripts (SURVEY §5 "Metrics/logging/observability: no Prometheus/
 Grafana") — so this is framework-over-reference functionality the north star
-asks for: tok/s, TTFT p50/p95 under continuous batching, preemptions, KV page
+asks for: tok/s, TTFT under continuous batching, preemptions, KV page
 occupancy.
 
 Counters come from engine.EngineStats (filled inside the step loop) and
-scheduler/allocator state; this module only formats. Text format per the
-Prometheus exposition spec — scrapeable without any client library.
+scheduler/allocator state; latency distributions are REAL histograms
+(``_bucket``/``_sum``/``_count`` with outcome labels, rendered by the
+engine's Observability) so Prometheus can compute any quantile across
+replicas — the two-point host-side summaries this module used to emit
+could not aggregate. Text format per the exposition spec, scrapeable
+without any client library; nan-free by construction even on a freshly
+started server.
 """
 
 from __future__ import annotations
@@ -43,7 +48,6 @@ class Metrics:
         stats = eng.stats
         sched = eng.scheduler
         alloc = sched.allocator
-        q = stats.quantile
         lines = [
             "# TYPE kgct_requests_total counter",
             f"kgct_requests_total {self.requests_total}",
@@ -69,13 +73,11 @@ class Metrics:
             f"kgct_kv_pages_total {alloc.num_pages}",
             "# TYPE kgct_kv_pages_free gauge",
             f"kgct_kv_pages_free {alloc.num_free}",
-            "# TYPE kgct_ttft_seconds summary",
-            f'kgct_ttft_seconds{{quantile="0.5"}} {q(stats.ttft_s, 0.5)}',
-            f'kgct_ttft_seconds{{quantile="0.95"}} {q(stats.ttft_s, 0.95)}',
-            "# TYPE kgct_step_seconds summary",
-            f'kgct_step_seconds{{quantile="0.5"}} {q(stats.step_s, 0.5)}',
-            f'kgct_step_seconds{{quantile="0.95"}} {q(stats.step_s, 0.95)}',
             "# TYPE kgct_uptime_seconds gauge",
             f"kgct_uptime_seconds {time.monotonic() - self._started:.1f}",
         ]
+        # Histograms (TTFT/TPOT/queue-wait/prefill/step/batch-size/e2e),
+        # per-phase step-time counters, and the sampled-decode-ratio gauge —
+        # all owned by the engine's Observability.
+        lines.extend(eng.obs.render_prometheus())
         return "\n".join(lines) + "\n"

@@ -117,6 +117,20 @@ def test_router_fronts_models():
     assert svc["spec"]["ports"][0]["port"] == 80
 
 
+def test_scrape_annotations_engine_only():
+    """Engine pods carry prometheus.io scrape annotations; router pods must
+    NOT — the router's /metrics re-exports every engine's series (replica-
+    labeled), so scraping both would double-ingest each sample."""
+    ms = render_values(copy.deepcopy(VALUES))
+    eng_meta = ms["qwen3-engine-deployment.yaml"]["spec"]["template"]["metadata"]
+    ann = eng_meta["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/port"] == "8000"
+    assert ann["prometheus.io/path"] == "/metrics"
+    router_meta = ms["router-deployment.yaml"]["spec"]["template"]["metadata"]
+    assert "prometheus.io/scrape" not in (router_meta.get("annotations") or {})
+
+
 def test_rayspec_renders_statefulset_with_coordinator():
     values = copy.deepcopy(VALUES)
     spec = values["servingEngineSpec"]["modelSpec"][0]

@@ -296,6 +296,10 @@ def test_prompt_too_long_rejected():
     eng = make_engine()
     with pytest.raises(ValueError, match="exceeds"):
         eng.add_request("x", list(range(1000)))
+    # The rejected request's trace span must be CLOSED (arrival + abort) —
+    # an unpaired open would render as running forever in /debug/trace.
+    kinds = [e.kind for e in eng.obs.tracer.events() if e.request_id == "x"]
+    assert kinds == ["arrival", "abort"]
 
 
 class TestDecodeWindowEquivalence:

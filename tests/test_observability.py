@@ -1,0 +1,294 @@
+"""Observability subsystem unit tests: histogram exposition, trace ring +
+Perfetto export, step-phase bookkeeping, lifecycle hooks, and the bench
+output-assembly/emission contract (the driver parses stdout's LAST line)."""
+
+import json
+import logging
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_gpu_cluster_tpu.observability import (  # noqa: E402
+    PHASES, Histogram, Observability, render_gauge)
+from kubernetes_gpu_cluster_tpu.observability.phases import (  # noqa: E402
+    StepPhaseStats)
+from kubernetes_gpu_cluster_tpu.observability.trace import (  # noqa: E402
+    RequestTracer)
+
+
+class _Seq:
+    """Minimal Sequence stand-in carrying the lifecycle fields the
+    Observability hooks read/write."""
+
+    def __init__(self, rid, arrival=100.0):
+        self.request_id = rid
+        self.arrival_time = arrival
+        self.first_token_time = None
+        self.scheduled_time = None
+        self.finish_time = None
+        self.preempt_count = 0
+        self.num_prompt_tokens = 8
+        self.num_output_tokens = 0
+
+
+class TestHistogram:
+    def test_empty_renders_zero_and_nan_free(self):
+        h = Histogram("t_seconds", "help")
+        lines = h.render()
+        assert "# TYPE t_seconds histogram" in lines
+        assert any(l == "t_seconds_count 0" for l in lines)
+        assert any(l == "t_seconds_sum 0" for l in lines)
+        assert not any("nan" in l.lower() for l in lines)
+
+    def test_bucket_monotonicity_and_sum_count(self):
+        h = Histogram("t_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        lines = h.render()
+        cums = [int(l.split()[-1]) for l in lines if "_bucket" in l]
+        assert cums == sorted(cums)
+        assert cums[-1] == 5                       # +Inf == count
+        assert any(l == "t_seconds_count 5" for l in lines)
+        [s] = [float(l.split()[-1]) for l in lines if l.startswith("t_seconds_sum")]
+        assert abs(s - 56.05) < 1e-9
+
+    def test_nan_observation_dropped(self):
+        h = Histogram("t_seconds")
+        h.observe(float("nan"))
+        assert h.count == 0
+
+    def test_labeled_cells_render_separately(self):
+        h = Histogram("t_seconds", labels=("outcome",))
+        h.observe(0.2, ("finished",))
+        h.observe(3.0, ("aborted",))
+        text = "\n".join(h.render())
+        assert 'outcome="finished"' in text and 'outcome="aborted"' in text
+        assert text.count("_count") == 2
+
+    def test_render_gauge_absent_when_none(self):
+        assert render_gauge("g", None) == []
+        assert render_gauge("g", float("nan")) == []
+        assert render_gauge("g", 0.5) == ["# TYPE g gauge", "g 0.5"]
+
+
+class TestRequestTracer:
+    def test_ring_bounded_and_disable(self):
+        tr = RequestTracer(capacity=4)
+        for i in range(10):
+            tr.emit("queued", f"r{i}")
+        evs = tr.events()
+        assert len(evs) == 4 and evs[0].request_id == "r6"
+        off = RequestTracer(enabled=False)
+        off.emit("queued", "r0")
+        assert off.events() == []
+
+    def test_step_events_never_evict_request_events(self):
+        # Sustained decode emits one engine-wide instant per step; a flood
+        # of them must not push request-lifecycle events off the ring.
+        tr = RequestTracer(capacity=8)
+        tr.emit("arrival", "a")
+        for _ in range(100):
+            tr.emit("decode", "", batch=4, tokens=4)
+        kinds = [e.kind for e in tr.events()]
+        assert "arrival" in kinds
+        assert kinds.count("decode") <= 2      # capacity // 4
+        tr.clear()
+        assert tr.events() == []
+
+    def test_perfetto_spans_pair_and_orphan_close_synthesized(self):
+        tr = RequestTracer()
+        tr.emit("arrival", "a")
+        tr.emit("first_token", "a", ttft_ms=5.0)
+        tr.emit("finish", "a", outcome="finished")
+        tr.emit("finish", "orphan", outcome="finished")  # arrival fell off
+        doc = tr.export_perfetto()
+        evs = [e for e in doc["traceEvents"] if e.get("cat") == "request"]
+        a_phs = [e["ph"] for e in evs if e.get("id") == "a"]
+        assert a_phs == ["b", "n", "e"]
+        orphan = [e for e in evs if e.get("id") == "orphan"]
+        assert [e["ph"] for e in orphan] == ["b", "e"]   # synthesized open
+        json.loads(json.dumps(doc))                      # wire-serializable
+
+    def test_perfetto_step_slices(self):
+        tr = RequestTracer()
+        recs = [{"step": 1, "kind": "decode", "batch": 4,
+                 "phases": [("device_dispatch", 10.0, 0.002),
+                            ("device_fetch", 10.002, 0.001)]}]
+        doc = tr.export_perfetto(step_records=recs)
+        slices = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert {s["name"] for s in slices} == {"device_dispatch",
+                                               "device_fetch"}
+        assert all(s["dur"] > 0 for s in slices)
+
+
+class TestStepPhaseStats:
+    def test_phase_context_accumulates(self):
+        st = StepPhaseStats()
+        st.start_step()
+        with st.phase("schedule"):
+            pass
+        with st.phase("device_fetch"):
+            pass
+        st.end_step(step=1, kind="decode", batch=2, duration_s=0.01)
+        assert st.counts["schedule"] == 1
+        assert st.steps_recorded == 1
+        assert st.step_records()[0]["kind"] == "decode"
+        b = st.breakdown()
+        assert set(b) == set(PHASES)
+        assert b["schedule"]["count"] == 1
+
+    def test_discard_drops_record_keeps_totals(self):
+        st = StepPhaseStats()
+        st.start_step()
+        with st.phase("schedule"):
+            pass
+        total = st.totals["schedule"]
+        st.discard_step()
+        assert st.step_records() == []
+        assert st.totals["schedule"] == total >= 0.0
+
+    def test_detokenize_out_of_step_record(self):
+        st = StepPhaseStats()
+        st.record("detokenize", 0.004)
+        assert st.counts["detokenize"] == 1
+        assert st.breakdown()["detokenize"]["mean_ms"] == 4.0
+        # Out-of-step slices must not touch the engine thread's step-local
+        # state (they arrive from the HTTP event-loop thread mid-step) —
+        # they surface through detached_records() instead.
+        assert st._current == [] and st.current_durs == {}
+        [rec] = st.detached_records()
+        assert rec["kind"] == "http"
+        assert [p[0] for p in rec["phases"]] == ["detokenize"]
+
+    def test_clear_records_drops_rings_keeps_totals(self):
+        st = StepPhaseStats()
+        st.start_step()
+        with st.phase("schedule"):
+            pass
+        st.end_step(step=1, kind="decode", batch=1, duration_s=0.01)
+        st.record("detokenize", 0.002)
+        st.clear_records()
+        assert st.step_records() == [] and st.detached_records() == []
+        assert st.counts["schedule"] == 1 and st.counts["detokenize"] == 1
+
+
+class TestObservabilityLifecycle:
+    def _run_request(self, obs, rid="r1", preempt=False):
+        seq = _Seq(rid)
+        obs.on_arrival(seq)
+        obs.on_queued(seq, depth=1)
+        seq.arrival_time = 0.0
+        if preempt:
+            obs.on_preempt(seq)
+        obs.on_scheduled(seq, 1)
+        seq.first_token_time = seq.scheduled_time + 0.05
+        obs.on_first_token(seq, fetch_s=0.01)
+        seq.num_output_tokens = 5
+        obs.on_finish(seq, None)
+        return seq
+
+    def test_queue_ttft_e2e_histograms_fill(self):
+        obs = Observability(enabled=True)
+        self._run_request(obs)
+        assert obs.queue_wait.count == 1
+        assert obs.ttft.count == 1
+        assert obs.e2e_latency.count == 1
+        assert obs.tpot.count == 1
+        d = obs.ttft_decomposition()
+        assert d["samples"] == 1
+        assert d["prefill_ms"] >= 0 and d["first_fetch_ms"] == 10.0
+
+    def test_finish_idempotent_and_outcome_labels(self):
+        obs = Observability(enabled=True)
+        seq = self._run_request(obs, preempt=True)
+        obs.on_finish(seq, None)       # double-finish: second is a no-op
+        assert obs.e2e_latency.count == 1
+        text = "\n".join(obs.e2e_latency.render())
+        assert 'outcome="preempted"' in text
+
+    def test_sampled_decode_ratio_gauge(self):
+        obs = Observability(enabled=True)
+        assert obs.sampled_decode_ratio() is None     # one mode only
+        obs.on_step(1, "decode", 4, 0.1, 100, mode="greedy")
+        assert obs.sampled_decode_ratio() is None
+        obs.on_step(2, "decode", 4, 0.1, 90, mode="sampled")
+        assert abs(obs.sampled_decode_ratio() - 0.9) < 1e-9
+        text = "\n".join(obs.render_prometheus())
+        assert "kgct_sampled_decode_ratio 0.9" in text
+
+    def test_clear_trace_scopes_capture(self):
+        obs = Observability(enabled=True)
+        self._run_request(obs)
+        obs.phases.start_step()
+        with obs.phases.phase("device_dispatch"):
+            pass
+        obs.on_step(1, "decode", 1, 0.01, 1, mode="greedy")
+        obs.phases.record("detokenize", 0.001)     # detached (HTTP thread)
+        evs = obs.export_perfetto()["traceEvents"]
+        assert {"device_dispatch", "detokenize"} <= {
+            e["name"] for e in evs if e.get("ph") == "X"}
+        obs.clear_trace()
+        evs = obs.export_perfetto()["traceEvents"]
+        # Metadata only: request spans, step slices AND detached slices all
+        # emptied — a ?clear=1 scoped capture starts from nothing.
+        assert {e.get("ph") for e in evs} == {"M"}
+        assert obs.ttft.count == 1                 # /metrics state untouched
+
+    def test_render_prometheus_fresh_is_nan_free(self):
+        obs = Observability(enabled=True)
+        text = "\n".join(obs.render_prometheus())
+        assert "nan" not in text.lower()
+        assert "kgct_step_phase_seconds_total" in text
+
+
+class TestJsonLogFormat:
+    def test_json_formatter_carries_request_id(self):
+        from kubernetes_gpu_cluster_tpu.utils.logging import _JsonFormatter
+        rec = logging.LogRecord("kgct.engine", logging.WARNING, __file__, 1,
+                                "preempted %s", ("req-9",), None)
+        rec.request_id = "req-9"
+        entry = json.loads(_JsonFormatter().format(rec))
+        assert entry["level"] == "WARNING"
+        assert entry["msg"] == "preempted req-9"
+        assert entry["request_id"] == "req-9"
+
+    def test_plain_record_has_no_request_id(self):
+        from kubernetes_gpu_cluster_tpu.utils.logging import _JsonFormatter
+        rec = logging.LogRecord("kgct.x", logging.INFO, __file__, 1,
+                                "hello", (), None)
+        entry = json.loads(_JsonFormatter().format(rec))
+        assert "request_id" not in entry
+
+
+class TestBenchOutputContract:
+    def _fake_results(self):
+        return [{
+            "model": "debug-tiny", "quantization": None, "batch": 8,
+            "decode_window": 4, "prefill_budget": 256,
+            "decode_tokens_per_sec": 123.4,
+            "sampled_over_greedy": 0.95,
+            "ttft_decomposition": {"queue_ms": 1.0, "prefill_ms": 2.0,
+                                   "first_fetch_ms": 3.0, "samples": 8},
+        }]
+
+    def test_assemble_output_round_trips_json(self):
+        import bench
+        out = bench.assemble_output(self._fake_results(), "cpu")
+        reparsed = json.loads(json.dumps(out))
+        assert reparsed["value"] == 123.4
+        assert reparsed["backend"] == "cpu"
+        d = reparsed["ttft_decomposition"]
+        assert {"queue_ms", "prefill_ms", "first_fetch_ms"} <= set(d)
+        assert reparsed["sampled_over_greedy"] == 0.95
+        assert not math.isnan(reparsed["vs_baseline"])
+
+    def test_emit_result_last_stdout_line_parses(self, capsys):
+        import bench
+        print("some earlier unflushed noise")
+        bench.emit_result(bench.assemble_output(self._fake_results(), "cpu"))
+        captured = capsys.readouterr().out
+        last = captured.rstrip("\n").splitlines()[-1]
+        parsed = json.loads(last)
+        assert parsed["unit"] == "tokens/s/chip"

@@ -72,12 +72,16 @@ def _engine_config():
                                   decode_window=4))
 
 
+_SERVER: dict = {}      # module-scope handle to the live APIServer (obs tests)
+
+
 @pytest.fixture(scope="module")
 def api_client():
     """One engine + server shared by the module (compiles once)."""
     loop = asyncio.new_event_loop()
     server = build_server(_engine_config(), tokenizer_path=None,
                           model_name="debug-tiny")
+    _SERVER["api"] = server
     client = TestClient(TestServer(server.build_app()), loop=loop)
     loop.run_until_complete(client.start_server())
     yield loop, client
@@ -179,35 +183,66 @@ class TestAPIServer:
             assert r.status == 200
             text = await r.text()
             assert "kgct_tokens_generated_total" in text
-            assert "kgct_ttft_seconds" in text
             assert "kgct_kv_pages_free" in text
             return text
         text = loop.run_until_complete(go())
         gen = [l for l in text.splitlines()
                if l.startswith("kgct_tokens_generated_total")]
         assert int(gen[0].split()[-1]) > 0   # previous tests generated tokens
+        # Real histograms with filled buckets for the north-star latencies
+        # (previous tests completed requests), validated structurally.
+        _assert_valid_exposition(text)
+        for fam in ("kgct_ttft_seconds", "kgct_tpot_seconds",
+                    "kgct_queue_wait_seconds", "kgct_step_seconds",
+                    "kgct_request_e2e_seconds", "kgct_batch_size_per_step"):
+            assert f"# TYPE {fam} histogram" in text, fam
+            assert f"{fam}_bucket" in text, f"{fam}: no observations"
+        assert 'le="+Inf"' in text
+        assert "kgct_step_phase_seconds_total" in text
+
+
+def _parse_sample(line: str):
+    """One exposition sample line -> (base_name, labels_dict, float_value)."""
+    import re
+    name_part, _, val = line.rpartition(" ")
+    base, _, rest = name_part.partition("{")
+    labels = dict(re.findall(r'(\w+)="([^"]*)"', rest))
+    return base, labels, float(val)
 
 
 def _assert_valid_exposition(text: str) -> None:
     """Prometheus text-format validity as strict parsers enforce it: at most
-    one TYPE line per metric family, and all of a family's samples contiguous
-    (a family's block ends as soon as another family's line appears)."""
+    one TYPE line per metric family with all of a family's samples contiguous
+    (a family's block ends as soon as another family's line appears); every
+    sample value finite (no nan, even on a freshly started server); histogram
+    families structurally sound — per labelset, cumulative bucket counts
+    monotone non-decreasing, the +Inf bucket equal to ``_count``, and a
+    matching ``_sum``/``_count`` pair present."""
+    import math
+
     closed: set[str] = set()
     current = None
+    types: dict[str, str] = {}
+    by_name: dict[str, list] = {}
     for line in text.splitlines():
         if not line:
             continue
         if line.startswith("# TYPE"):
-            fam = line.split()[2]
+            parts = line.split()
+            fam = parts[2]
             assert fam not in closed and fam != current, (
                 f"duplicate TYPE for family {fam}")
+            assert fam not in types, f"duplicate TYPE for family {fam}"
+            types[fam] = parts[3]
             if current is not None:
                 closed.add(current)
             current = fam
             continue
         if line.startswith("#"):
             continue
-        base = line.partition("{")[0].partition(" ")[0]
+        base, labels, value = _parse_sample(line)
+        assert not math.isnan(value), f"nan in exposition: {line!r}"
+        by_name.setdefault(base, []).append((labels, value))
         fam = (current if current is not None and
                (base == current or base.startswith(current + "_"))
                else base)
@@ -217,6 +252,105 @@ def _assert_valid_exposition(text: str) -> None:
             current = fam
         assert fam not in closed, (
             f"samples of family {fam} are not contiguous: {line!r}")
+
+    def cell_key(labels):
+        return tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+
+    for fam, typ in types.items():
+        if typ != "histogram":
+            continue
+        buckets = by_name.get(fam + "_bucket", [])
+        sums = {cell_key(l): v for l, v in by_name.get(fam + "_sum", [])}
+        counts = {cell_key(l): v for l, v in by_name.get(fam + "_count", [])}
+        if not (buckets or sums or counts):
+            continue    # labeled histogram with no observations yet: legal
+        assert buckets and sums and counts, f"{fam}: incomplete histogram"
+        series: dict = {}
+        for labels, v in buckets:
+            series.setdefault(cell_key(labels), []).append(
+                (labels["le"], v))
+        assert set(series) == set(sums) == set(counts), (
+            f"{fam}: bucket/_sum/_count labelsets disagree")
+        for key, bs in series.items():
+            def le_val(le):
+                return float("inf") if le == "+Inf" else float(le)
+            bs = sorted(bs, key=lambda x: le_val(x[0]))
+            cums = [v for _, v in bs]
+            assert cums == sorted(cums), (
+                f"{fam}{dict(key)}: non-monotone buckets {cums}")
+            assert bs[-1][0] == "+Inf", f"{fam}{dict(key)}: missing +Inf"
+            assert cums[-1] == counts[key], (
+                f"{fam}{dict(key)}: +Inf bucket {cums[-1]} != _count "
+                f"{counts[key]}")
+
+
+class TestObservability:
+    """The /debug/trace export and the engine's phase-attribution
+    bookkeeping, exercised through real API traffic."""
+
+    def test_debug_trace_perfetto_export(self, api_client):
+        loop, client = api_client
+
+        async def go():
+            # Fresh traffic so the ring holds a complete lifecycle.
+            r = await client.post("/v1/completions", json={
+                "prompt": "trace me", "max_tokens": 4, "temperature": 0.0})
+            assert r.status == 200
+            r = await client.get("/debug/trace")
+            assert r.status == 200
+            return await r.json()
+        doc = loop.run_until_complete(go())
+        evs = doc["traceEvents"]
+        assert isinstance(evs, list) and evs
+        # Perfetto-loadable skeleton: process/thread metadata present.
+        assert any(e.get("ph") == "M" for e in evs)
+        # Request lifecycle spans: async begin/end pairs keyed by request id,
+        # with the instant events (queued/scheduled/first_token) in between.
+        reqs = [e for e in evs if e.get("cat") == "request"]
+        opens = {e["id"] for e in reqs if e["ph"] == "b"}
+        closes = {e["id"] for e in reqs if e["ph"] == "e"}
+        assert opens and opens & closes, "no complete request span"
+        names = {e["name"] for e in reqs if e["ph"] == "n"}
+        assert {"queued", "scheduled", "first_token"} <= names
+        # Step-phase attribution slices on the engine.step track.
+        slices = [e for e in evs if e.get("ph") == "X"]
+        assert {"schedule", "device_dispatch"} <= {s["name"] for s in slices}
+        assert all(s["ts"] >= 0 and s["dur"] >= 0 for s in slices)
+        json.dumps(doc)     # round-trips to the wire format
+
+    def test_trace_clear_param(self, api_client):
+        loop, client = api_client
+
+        async def go():
+            r = await client.get("/debug/trace?clear=1")
+            assert r.status == 200
+            r2 = await client.get("/debug/trace")
+            return await r2.json()
+        doc = loop.run_until_complete(go())
+        assert not [e for e in doc["traceEvents"]
+                    if e.get("cat") == "request"]
+
+    def test_phase_attribution_bookkeeping(self, api_client):
+        loop, client = api_client
+
+        async def go():
+            r = await client.post("/v1/completions", json={
+                "prompt": "phases", "max_tokens": 4, "temperature": 0.0})
+            assert r.status == 200
+        loop.run_until_complete(go())
+        obs = _SERVER["api"].engine.engine.obs
+        assert obs.phases.steps_recorded > 0
+        for phase in ("schedule", "host_prep", "device_dispatch",
+                      "device_fetch", "postproc", "detokenize"):
+            assert obs.phases.totals[phase] > 0.0, f"{phase} never recorded"
+        b = obs.phases.breakdown()
+        assert b["device_dispatch"]["count"] > 0
+        assert b["device_dispatch"]["mean_ms"] >= 0
+        # The TTFT decomposition bench.py folds into its JSON line.
+        d = obs.ttft_decomposition()
+        assert d["samples"] > 0
+        assert all(k in d for k in ("queue_ms", "prefill_ms",
+                                    "first_fetch_ms"))
 
 
 class TestRouter:
