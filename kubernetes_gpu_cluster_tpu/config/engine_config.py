@@ -70,11 +70,40 @@ class ParallelConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance knobs (kubernetes_gpu_cluster_tpu.resilience): TTFT
+    deadlines + load shedding, the engine step watchdog, graceful drain, and
+    multihost failure detection. Defaults keep pre-existing behavior except
+    where detection is pure upside (watchdog, heartbeats)."""
+    # Default TTFT budget applied to requests that carry no
+    # x-kgct-ttft-budget-ms header; None = admit everything (no shedding).
+    default_ttft_budget_ms: Optional[float] = None
+    # Queue-wait estimator quantile over kgct_queue_wait_seconds.
+    admission_quantile: float = 0.9
+    # A step running longer than this flips /health (hung device dispatch).
+    # The default must exceed the WORST first-use XLA compile: the engine
+    # compiles one program per (kind, bucketed shape) lazily inside the
+    # first step that needs it (60-180 s for big models on TPU), and a
+    # tighter default would crash-loop pods during normal warm-up. Tighten
+    # per-deployment once the shape set is warm.
+    watchdog_timeout_s: float = 300.0
+    # SIGTERM drain: max wait for in-flight requests before exiting anyway.
+    drain_grace_s: float = 120.0
+    # Multihost leader->follower heartbeat cadence, and how long a follower
+    # tolerates silence (no directives, no heartbeats) before declaring the
+    # leader dead and group-aborting.
+    heartbeat_interval_s: float = 2.0
+    liveness_timeout_s: float = 10.0
+
+
+@dataclasses.dataclass(frozen=True)
 class EngineConfig:
     model: ModelConfig
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    resilience: ResilienceConfig = dataclasses.field(
+        default_factory=ResilienceConfig)
     max_model_len: Optional[int] = None  # override model.max_model_len
     seed: int = 0
     enforce_eager: bool = False          # parity with vllm --enforce-eager: disable

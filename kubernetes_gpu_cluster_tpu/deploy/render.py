@@ -163,13 +163,23 @@ def _pod_spec(spec: dict, engine: dict, multihost: bool) -> dict:
             "httpGet": {"path": "/health", "port": ENGINE_PORT},
             "initialDelaySeconds": 120, "periodSeconds": 10,
             "failureThreshold": 6},
+        # Graceful drain contract (serving SIGTERM handler): the preStop
+        # sleep lets endpoint-controller removal propagate BEFORE SIGTERM
+        # lands, so no new connections race the drain; the engine then stops
+        # admitting (503 + Retry-After), finishes in-flight streams, and
+        # exits on its own — terminationGracePeriodSeconds must outlast the
+        # engine's drain_grace_s (120 s default) or SIGKILL truncates
+        # streams the drain was built to protect.
+        "lifecycle": {"preStop": {"exec": {
+            "command": ["sh", "-c", "sleep 5"]}}},
     }
     if env:
         container["env"] = env
     if mounts:
         container["volumeMounts"] = mounts
 
-    pod: dict[str, Any] = {"containers": [container]}
+    pod: dict[str, Any] = {"containers": [container],
+                           "terminationGracePeriodSeconds": 150}
     if volumes:
         pod["volumes"] = volumes
     if engine.get("runtimeClassName"):

@@ -34,6 +34,7 @@ from ..models.llama import DecodeMeta, PrefillMeta
 from ..ops.sampling import (apply_logit_bias, apply_penalties, build_counts,
                             bump_counts, gated_top_logprobs, row_sample_keys,
                             sample_and_logprobs, token_logprobs)
+from ..resilience.faults import inject as _inject_fault
 from ..utils import cdiv, get_logger
 from .kv_cache import KVCache, allocate_kv_cache, derive_num_pages
 from .sampling_params import LOGIT_BIAS_CAP, SamplingParams
@@ -743,6 +744,10 @@ class LLMEngine:
         return self.scheduler.has_work() or self._inflight is not None
 
     def step(self) -> list[RequestOutput]:
+        # Chaos site: KGCT_FAULT=step_stall:delay=N sleeps here, simulating a
+        # hung device dispatch for the watchdog to catch. One is-armed check
+        # when no spec is set — free on the hot path.
+        _inject_fault("step_stall")
         self.obs.phases.start_step()
         # Set by _step when a device program actually ran this iteration:
         # (kind, batch_size, decode_mode) — None means an idle/drain-only

@@ -79,6 +79,32 @@ class Histogram:
     def count(self) -> int:
         return sum(cell[2] for cell in self._cells.values())
 
+    @property
+    def sum(self) -> float:
+        return sum(cell[1] for cell in self._cells.values())
+
+    def merged_counts(self) -> list:
+        """Per-bucket counts summed over all labelsets, with one extra
+        trailing cell for observations ABOVE the last finite bound (observe
+        drops those from the bucket array; the quantile must still rank
+        them). A snapshot callers can difference against a later one for
+        WINDOWED quantiles (counts only grow, so deltas stay valid)."""
+        merged = [0] * (len(self.buckets) + 1)
+        for cell in self._cells.values():
+            finite = 0
+            for i, c in enumerate(cell[0]):
+                merged[i] += c
+                finite += c
+            merged[-1] += cell[2] - finite
+        return merged
+
+    def quantile(self, q: float) -> float:
+        """Histogram-quantile over all labelsets, Prometheus-style (see
+        quantile_from_counts). Served live to the admission controller, so
+        it reads under concurrent observe(): bucket counts are snapshotted
+        by merged_counts first."""
+        return quantile_from_counts(self.buckets, self.merged_counts(), q)
+
     def _labelstr(self, values: tuple, extra: str = "") -> str:
         pairs = [f'{k}="{v}"' for k, v in zip(self.label_names, values)]
         if extra:
@@ -107,6 +133,28 @@ class Histogram:
                          f"{fmt(round(total, 6))}")
             lines.append(f"{self.name}_count{self._labelstr(values)} {n}")
         return lines
+
+
+def quantile_from_counts(buckets: tuple, counts: list, q: float) -> float:
+    """Prometheus-style histogram quantile over per-bucket counts (counts
+    may carry one extra trailing overflow cell, merged_counts-style): find
+    the bucket holding the q-th observation and interpolate linearly inside
+    it (lower bound 0 for the first bucket; overflow observations clamp to
+    the last finite bound). 0.0 on an empty set — callers treat "no data"
+    as "no wait", the right admission-control default for a fresh server."""
+    n = sum(counts)
+    if n <= 0:
+        return 0.0
+    rank = q * n
+    cum = 0
+    lo = 0.0
+    for bound, c in zip(buckets, counts):
+        if cum + c >= rank and c > 0:
+            frac = (rank - cum) / c
+            return lo + (bound - lo) * min(max(frac, 0.0), 1.0)
+        cum += c
+        lo = bound
+    return buckets[-1]
 
 
 def render_gauge(name: str, value: Optional[float],

@@ -7,7 +7,8 @@ The reference's user-facing contract: an OpenAI API served behind
 - ``POST /v1/completions``        text in -> text out, optional SSE streaming
 - ``POST /v1/chat/completions``   chat messages via the model's chat template
 - ``GET  /v1/models``             the model card the router aggregates
-- ``GET  /health``                liveness + engine queue depth
+- ``GET  /health``                liveness + engine queue depth (503 while
+                                  draining or when the step watchdog trips)
 - ``GET  /metrics``               Prometheus text format (serving.metrics)
 - ``GET  /debug/trace``           request-lifecycle + step-phase trace
                                   (Chrome/Perfetto trace-event JSON)
@@ -17,6 +18,16 @@ Stop semantics: stop TOKEN ids fire inside the engine; stop STRINGS are
 evaluated here on incrementally detokenized text (IncrementalDetokenizer
 holds back a potential partial match, then the request is aborted
 engine-side so no further device work is spent on it).
+
+Fault tolerance (kubernetes_gpu_cluster_tpu.resilience): requests may carry
+a TTFT budget in the ``x-kgct-ttft-budget-ms`` header (or inherit
+``ResilienceConfig.default_ttft_budget_ms``); a request whose budget is
+already blown by the estimated queue wait is SHED with an OpenAI-shaped
+``429 + Retry-After`` instead of being admitted into a multi-second queue.
+SIGTERM (CLI path) starts a graceful drain: admissions stop with 503,
+``/health`` flips so the endpoint controller drops the pod, and in-flight
+streams finish before exit. A step watchdog flips ``/health`` when device
+dispatch hangs so kubelet's liveness probe restarts the pod.
 """
 
 from __future__ import annotations
@@ -29,14 +40,23 @@ from typing import Any, Optional
 from aiohttp import web
 
 from ..config import EngineConfig
+from ..config.engine_config import ResilienceConfig
 from ..engine import SamplingParams
+from ..resilience import (AdmissionController, DrainState, ResilienceHub,
+                          StepWatchdog)
+from ..resilience.drain import drain_and_notify
 from ..utils import get_logger
 from .async_engine import AsyncLLMEngine
+from .errors import overloaded_error as _overloaded
 from .metrics import Metrics
 from .tokenizer import (IncrementalDetokenizer, Tokenizer,
                         apply_chat_template, load_tokenizer)
 
 logger = get_logger("serving.api")
+
+# Per-request TTFT budget (milliseconds). Absent -> the config default;
+# both absent -> admit unconditionally (pre-resilience behavior).
+TTFT_BUDGET_HEADER = "x-kgct-ttft-budget-ms"
 
 
 def _sampling_params(body: dict, eos_token_id: Optional[int],
@@ -88,13 +108,24 @@ def _stops(body: dict) -> list[str]:
 
 class APIServer:
     def __init__(self, engine: AsyncLLMEngine, tokenizer: Tokenizer,
-                 model_name: str):
-        import asyncio
+                 model_name: str,
+                 resilience: Optional[ResilienceConfig] = None):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.metrics = Metrics(engine.engine)
         self._profile_busy = False
+        res = resilience or ResilienceConfig()
+        self.res_config = res
+        self.drain_state = DrainState()
+        self.watchdog = StepWatchdog(timeout_s=res.watchdog_timeout_s)
+        self.admission = AdmissionController(
+            engine.engine, default_budget_ms=res.default_ttft_budget_ms,
+            quantile=res.admission_quantile)
+        self.hub = ResilienceHub(self.admission, self.watchdog,
+                                 self.drain_state)
+        # The worker thread arms/disarms the watchdog around each step().
+        engine.watchdog = self.watchdog
 
     # -- app wiring ----------------------------------------------------------
 
@@ -114,21 +145,76 @@ class APIServer:
     async def _on_startup(self, app: web.Application) -> None:
         import asyncio
         self.engine.start(asyncio.get_running_loop())
+        self.watchdog.start()
 
     async def _on_cleanup(self, app: web.Application) -> None:
         self.engine.shutdown()
+        self.watchdog.stop()
+
+    # -- resilience gates ----------------------------------------------------
+
+    def begin_drain(self, on_drained=None):
+        """Start graceful drain (idempotent): stop admitting, flip /health,
+        finish in-flight work, then fire ``on_drained``. Returns the drain
+        task, or None if a drain was already running. Must be called on the
+        server's event loop (the SIGTERM handler and tests both are)."""
+        import asyncio
+        if not self.drain_state.start_drain():
+            return None
+        return asyncio.get_running_loop().create_task(drain_and_notify(
+            self.drain_state, self.engine,
+            grace_s=self.res_config.drain_grace_s, on_drained=on_drained))
+
+    def _admission_gate(self, request: web.Request) -> Optional[web.Response]:
+        """None = admit. A Response = reject BEFORE the request touches the
+        engine: 503 while draining (k8s is taking the pod out of rotation),
+        429 + Retry-After when the estimated queue wait already blows the
+        request's TTFT budget (vLLM-style shed-don't-queue)."""
+        if self.drain_state.is_draining:
+            return _overloaded(503, "server is draining for shutdown; "
+                               "retry against another replica", 5)
+        hdr = request.headers.get(TTFT_BUDGET_HEADER)
+        budget_ms = None
+        if hdr is not None:
+            import math
+            try:
+                budget_ms = float(hdr)
+            except ValueError:
+                return _error(400, f"invalid {TTFT_BUDGET_HEADER}: {hdr!r} "
+                                   "(expected milliseconds as a number)")
+            # nan would pass "<= 0" and then fail every est<=budget check —
+            # shedding unconditionally on an idle server; inf means "no
+            # budget", which is spelled by omitting the header.
+            if not math.isfinite(budget_ms) or budget_ms <= 0:
+                return _error(400, f"{TTFT_BUDGET_HEADER} must be a finite "
+                                   "number > 0")
+        retry_after = self.admission.check(budget_ms)
+        if retry_after is not None:
+            est_ms = round(self.admission.last_estimate_s * 1e3, 1)
+            return _overloaded(
+                429, f"request shed: estimated queue wait {est_ms} ms "
+                     f"exceeds the TTFT budget; retry after the backlog "
+                     f"drains", retry_after)
+        return None
 
     # -- endpoints -----------------------------------------------------------
 
     async def health(self, request: web.Request) -> web.Response:
         sched = self.engine.engine.scheduler
-        return web.json_response({
-            "status": "ok", "model": self.model_name,
-            "waiting": len(sched.waiting), "running": len(sched.running)})
+        body = {"status": "ok", "model": self.model_name,
+                "waiting": len(sched.waiting), "running": len(sched.running)}
+        if self.drain_state.is_draining:
+            body["status"] = self.drain_state.state
+            return web.json_response(body, status=503)
+        if not self.watchdog.healthy:
+            body["status"] = "engine step hung (watchdog tripped)"
+            return web.json_response(body, status=503)
+        return web.json_response(body)
 
     async def prometheus(self, request: web.Request) -> web.Response:
-        return web.Response(text=self.metrics.render(),
-                            content_type="text/plain")
+        text = (self.metrics.render()
+                + "\n".join(self.hub.render_prometheus()) + "\n")
+        return web.Response(text=text, content_type="text/plain")
 
     async def trace(self, request: web.Request) -> web.Response:
         """Export the engine's request-lifecycle trace ring + step-phase
@@ -230,6 +316,9 @@ class APIServer:
 
     async def _run(self, request: web.Request, body: dict, ids: list[int],
                    kind: str) -> web.StreamResponse:
+        gate = self._admission_gate(request)
+        if gate is not None:
+            return gate
         n_lp, lp_err = _logprobs_requested(body)
         if lp_err is not None:
             return lp_err
@@ -561,6 +650,8 @@ def _error(status: int, message: str) -> web.Response:
         status=status)
 
 
+
+
 # -- entry point -------------------------------------------------------------
 
 def build_server(config: EngineConfig, tokenizer_path: Optional[str] = None,
@@ -570,7 +661,8 @@ def build_server(config: EngineConfig, tokenizer_path: Optional[str] = None,
     engine = AsyncLLMEngine(config, params=params,
                             eos_token_id=tokenizer.eos_token_id, mesh=mesh,
                             leader=leader)
-    return APIServer(engine, tokenizer, model_name or config.model.name)
+    return APIServer(engine, tokenizer, model_name or config.model.name,
+                     resilience=config.resilience)
 
 
 def main(argv: Optional[list[str]] = None) -> None:
@@ -687,21 +779,55 @@ def main(argv: Optional[list[str]] = None) -> None:
         # serving/multihost.py). A minimal /health endpoint keeps the
         # StatefulSet's shared httpGet probes satisfied.
         from ..engine import LLMEngine
+        from ..resilience.heartbeat import LoopLiveness
         from .multihost import serve_follower_health
-        serve_follower_health(args.port)
+        # Follower /health is tied to ACTUAL loop liveness: directives,
+        # leader heartbeats, and completed steps beat it; silence past the
+        # timeout (or a detected-dead leader) flips it to 503 so kubelet
+        # restarts the rank. The HEALTH timeout must tolerate a first-use
+        # XLA compile inside step() (no beats while stepping), so it is the
+        # watchdog bound, not the channel-silence bound — the tighter
+        # liveness_timeout_s governs only the recv deadline in run().
+        liveness = LoopLiveness(
+            timeout_s=max(config.resilience.liveness_timeout_s,
+                          config.resilience.watchdog_timeout_s))
+        serve_follower_health(args.port, liveness=liveness)
         tokenizer = load_tokenizer(args.tokenizer)
         engine = LLMEngine(config, params=params,
                            eos_token_id=tokenizer.eos_token_id, mesh=mesh)
-        follower.run(engine)
+        follower.run(engine, liveness=liveness,
+                     liveness_timeout_s=config.resilience.liveness_timeout_s)
         return
     leader = None
     import jax
     if jax.process_count() > 1:
         from .multihost import DirectiveLeader, follower_addrs_from_env
-        leader = DirectiveLeader(follower_addrs_from_env())
+        leader = DirectiveLeader(
+            follower_addrs_from_env(),
+            heartbeat_interval_s=config.resilience.heartbeat_interval_s)
     server = build_server(config, args.tokenizer, args.model, params=params,
                           mesh=mesh, leader=leader)
-    web.run_app(server.build_app(), host=args.host, port=args.port)
+    app = server.build_app()
+
+    async def _arm_sigterm(app_):
+        # k8s pod termination: SIGTERM -> begin_drain (stop admitting / flip
+        # health, finish in-flight streams), then exit via SIGINT (run_app's
+        # clean shutdown) well inside terminationGracePeriodSeconds. One
+        # drain implementation — the same begin_drain the tests exercise.
+        # Installed only on the CLI path — embedders keep their own signal
+        # handling.
+        import asyncio
+        import os
+        import signal as _signal
+
+        loop = asyncio.get_running_loop()
+        loop.add_signal_handler(
+            _signal.SIGTERM,
+            lambda: server.begin_drain(
+                on_drained=lambda: os.kill(os.getpid(), _signal.SIGINT)))
+
+    app.on_startup.append(_arm_sigterm)
+    web.run_app(app, host=args.host, port=args.port)
 
 
 if __name__ == "__main__":

@@ -50,6 +50,10 @@ class AsyncLLMEngine:
         self.engine = LLMEngine(config, params=params,
                                 eos_token_id=eos_token_id, mesh=mesh)
         self.leader = leader
+        # resilience.StepWatchdog, set by APIServer: armed around each
+        # step() so a hung device dispatch flips /health instead of parking
+        # requests forever behind a 200-ok server.
+        self.watchdog = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._queues: dict[str, asyncio.Queue] = {}
         self._inbox: list = []            # (request_id, token_ids, params)
@@ -132,16 +136,44 @@ class AsyncLLMEngine:
                 # stepping: their engines apply the same events and step
                 # once, keeping the SPMD collectives in lockstep. A broadcast
                 # failure means the process group is broken (a dead follower
-                # hangs the collectives anyway): fail every waiter loudly
-                # instead of dying silently with requests parked forever.
+                # hangs the collectives anyway): group-abort all in-flight
+                # work, fail every waiter loudly, and detach the leader —
+                # this rank stays serveable while the StatefulSet restarts
+                # the followers (restart-first recovery).
                 try:
                     self.leader.broadcast(inbox, aborts)
                 except Exception as e:
                     logger.exception("directive broadcast failed; "
-                                     "failing all requests")
+                                     "group-aborting in-flight work")
+                    # Waiters fail FIRST: the drain below steps an engine
+                    # whose process group just broke, and on a real
+                    # multi-host mesh those steps can hang on collectives —
+                    # clients must not be held hostage to that.
+                    err = RuntimeError(
+                        f"multihost process group failed: {e}")
                     for rid in list(self._queues):
-                        self._post_exc(rid, e)
-                    return
+                        self._post_exc(rid, err)
+                    try:
+                        self.leader.close()
+                    except Exception:
+                        pass
+                    self.leader = None
+                    from .multihost import group_abort
+                    # Armed watchdog: if the drain DOES hang on a dead
+                    # rank's collectives, /health flips and kubelet
+                    # restarts the pod (restart-first recovery) instead of
+                    # leaving a healthy-looking zombie.
+                    wd = self.watchdog
+                    if wd is not None:
+                        wd.arm()
+                    try:
+                        group_abort(self.engine)
+                    except Exception:
+                        logger.exception("group-abort drain failed")
+                    finally:
+                        if wd is not None:
+                            wd.disarm()
+                    continue
             for rid in aborts:
                 self.engine.abort_request(rid)
                 self._post(StreamChunk(rid, [], [], True, "abort"))
@@ -151,14 +183,24 @@ class AsyncLLMEngine:
                 except ValueError as e:   # oversized prompt etc.
                     self._post_exc(rid, e)
             if self.engine.has_unfinished_requests():
+                wd = self.watchdog
+                if wd is not None:
+                    wd.arm()
                 try:
                     for out in self.engine.step():
                         self._post(_chunk_of(out))
                 except Exception as e:  # engine wedged: fail all waiters
                     logger.exception("engine step failed")
+                    if wd is not None:
+                        # The loop is about to die: /health must STAY 503
+                        # (a disarm here would resurrect health on a server
+                        # that can never serve again; kubelet restarts it).
+                        wd.mark_dead(f"engine step raised: {e}")
                     for rid in list(self._queues):
                         self._post_exc(rid, e)
                     return
+                if wd is not None:
+                    wd.disarm()
 
     def _post(self, chunk: StreamChunk) -> None:
         queue = self._queues.get(chunk.request_id)

@@ -1,0 +1,24 @@
+"""Shared OpenAI-shaped error envelopes for the serving stack.
+
+One definition for engine shed/drain (api_server) and router-level
+rejections: the router's docstring promises clients parse the SAME envelope
+from both layers, so the shape lives in one place instead of drifting
+between two copies.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+
+def overloaded_error(status: int, message: str,
+                     retry_after_s: float) -> web.Response:
+    """Shed/drain/no-capacity rejection: OpenAI-shaped error body plus a
+    Retry-After header so well-behaved clients (and bench.py's overload
+    phase) back off for the time the backlog actually needs instead of
+    hammering a doomed queue."""
+    return web.json_response(
+        {"error": {"message": message, "type": "overloaded_error",
+                   "code": status}},
+        status=status,
+        headers={"Retry-After": str(max(int(retry_after_s), 1))})

@@ -6,13 +6,20 @@ replicas were plain Deployment pods spread by anti-affinity
 (``values-01-minimal-example2.yaml:10, 23-49``). This router is the native
 equivalent: an aiohttp reverse proxy that
 
-- tracks replica health (periodic GET /health; unhealthy replicas leave the
-  rotation and return on recovery — the k8s-native restart/rollout story of
-  SURVEY §5.3 at the traffic layer),
+- tracks replica health (probed immediately at startup, then periodic GET
+  /health; unhealthy replicas leave the rotation and return on recovery —
+  the k8s-native restart/rollout story of SURVEY §5.3 at the traffic layer),
 - balances by least-outstanding-requests (better than round-robin under
   continuous batching: a replica stuck on long generations accumulates
   in-flight count and sheds new work),
-- streams responses through unbuffered (SSE passthrough).
+- streams responses through unbuffered (SSE passthrough),
+- hardens every upstream call: per-attempt connect timeouts, a per-read
+  stall timeout that circuit-breaks replicas whose in-flight streams hang,
+  and bounded exponential-backoff retry of connect-phase failures (the only
+  phase where nothing reached the upstream, so re-sending is safe).
+
+Chaos sites (resilience.faults): ``router_connect`` simulates a connect
+failure on the picked replica, ``replica_hang`` a mid-stream read timeout.
 
 In-cluster, replica discovery is the headless-Service DNS name; static URLs
 work for local/dev. Deployment manifests are rendered by
@@ -24,14 +31,29 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import itertools
+import time
 from typing import Optional
 
 import aiohttp
 from aiohttp import web
 
+from ..resilience.faults import inject as _inject_fault
 from ..utils import get_logger
+# The engine's shed/drain responses use the same envelope (serving.errors):
+# a router-level 503 is handled by the identical client code path.
+from .errors import overloaded_error as _proxy_error
 
 logger = get_logger("serving.router")
+
+# Connect-PHASE failures: nothing reached the upstream, so failover/retry is
+# provably safe. ConnectionTimeoutError (sock_connect expired — the
+# blackholed-node case, no RST ever comes back) is distinct from the
+# sock_read ServerTimeoutError and joins the refused/unreachable class;
+# older aiohttp without the split falls back to connector errors only.
+CONNECT_PHASE_ERRORS: tuple = (aiohttp.ClientConnectorError,
+                               ConnectionRefusedError)
+if hasattr(aiohttp, "ConnectionTimeoutError"):
+    CONNECT_PHASE_ERRORS += (aiohttp.ConnectionTimeoutError,)
 
 HOP_HEADERS = {"transfer-encoding", "content-length", "connection",
                "keep-alive", "host"}
@@ -43,15 +65,47 @@ class Replica:
         self.healthy = True
         self.inflight = 0
         self.consecutive_failures = 0
+        # Traffic-failure bench expiry: a replica broken by proxy failures
+        # (connect/stall) may still answer /health 200 — its wedge detector
+        # (engine watchdog) is much slower than the router's. Probe success
+        # must not restore it before this cooldown, or traffic bounces
+        # straight back onto the wedged replica.
+        self.benched_until = 0.0
 
 
 class Router:
     def __init__(self, replica_urls: list[str],
                  health_interval_s: float = 5.0,
-                 fail_threshold: int = 2):
+                 fail_threshold: int = 2,
+                 connect_timeout_s: float = 5.0,
+                 stall_timeout_s: float = 60.0,
+                 response_timeout_s: float = 300.0,
+                 metrics_timeout_s: float = 2.0,
+                 connect_retries: int = 2,
+                 retry_backoff_s: float = 0.25,
+                 bench_cooldown_s: float = 30.0):
         self.replicas = [Replica(u) for u in replica_urls]
         self.health_interval_s = health_interval_s
         self.fail_threshold = fail_threshold
+        self.connect_timeout_s = connect_timeout_s
+        # Max seconds between CHUNKS once a response is streaming before the
+        # replica is declared stalled (generous: an overloaded engine can
+        # pause seconds between tokens; a wedged one goes silent forever).
+        self.stall_timeout_s = stall_timeout_s
+        # Max seconds to FIRST response bytes (headers). Deliberately much
+        # larger than stall_timeout_s: a non-streaming completion sends
+        # nothing until the whole generation finishes, and a slow-but-
+        # correct generation must not 502 or count toward fail_threshold.
+        self.response_timeout_s = response_timeout_s
+        self.metrics_timeout_s = metrics_timeout_s
+        # Connect-phase failures retry the whole replica set up to this many
+        # extra rounds with exponential backoff — rides out the blip where
+        # every replica is briefly restarting.
+        self.connect_retries = connect_retries
+        self.retry_backoff_s = retry_backoff_s
+        self.bench_cooldown_s = bench_cooldown_s
+        self.retries_total = 0
+        self.scrape_errors_total = 0
         self._rr = itertools.count()
         self._session: Optional[aiohttp.ClientSession] = None
         self._health_task: Optional[asyncio.Task] = None
@@ -70,8 +124,20 @@ class Router:
         return app
 
     async def _on_startup(self, app: web.Application) -> None:
+        # No session-wide sock_read: phase-specific deadlines are applied at
+        # the call sites (response_timeout_s for headers, stall_timeout_s
+        # between stream chunks) — a blanket read timeout would 502
+        # legitimately slow non-streaming generations.
         self._session = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10))
+            timeout=aiohttp.ClientTimeout(
+                total=None, sock_connect=self.connect_timeout_s))
+        # Cold-start probe: without it, a replica that is down RIGHT NOW
+        # still receives traffic for up to fail_threshold x interval before
+        # the periodic loop notices. One failed startup probe removes it
+        # immediately; the loop restores it on recovery.
+        await asyncio.gather(
+            *(self._check(r, startup=True) for r in self.replicas),
+            return_exceptions=True)
         self._health_task = asyncio.create_task(self._health_loop())
 
     async def _on_cleanup(self, app: web.Application) -> None:
@@ -88,22 +154,35 @@ class Router:
             await asyncio.gather(*(self._check(r) for r in self.replicas),
                                  return_exceptions=True)
 
-    async def _check(self, replica: Replica) -> None:
+    async def _check(self, replica: Replica, startup: bool = False) -> None:
         try:
-            async with self._session.get(f"{replica.url}/health") as resp:
+            async with self._session.get(
+                    f"{replica.url}/health",
+                    timeout=aiohttp.ClientTimeout(total=5)) as resp:
                 ok = resp.status == 200
         except Exception:
             ok = False
         if ok:
+            if time.monotonic() < replica.benched_until:
+                # Benched by TRAFFIC failures: a 200 probe proves only that
+                # /health answers, not that proxied streams stopped
+                # stalling (the engine's own wedge detector is slower than
+                # ours) — sit out the cooldown before trusting it again.
+                return
             replica.consecutive_failures = 0
             if not replica.healthy:
                 logger.info("replica %s back in rotation", replica.url)
             replica.healthy = True
         else:
             replica.consecutive_failures += 1
+            # At startup a single failure is disqualifying (no traffic
+            # history argues for the replica); in steady state the threshold
+            # rides out transient blips.
             if (replica.healthy
-                    and replica.consecutive_failures >= self.fail_threshold):
-                logger.warning("replica %s marked unhealthy", replica.url)
+                    and (startup or replica.consecutive_failures
+                         >= self.fail_threshold)):
+                logger.warning("replica %s marked unhealthy%s", replica.url,
+                               " (startup probe)" if startup else "")
                 replica.healthy = False
 
     async def health(self, request: web.Request) -> web.Response:
@@ -123,12 +202,21 @@ class Router:
         lines.append("# TYPE kgct_router_replica_inflight gauge")
         lines += [f'kgct_router_replica_inflight{{replica="{r.url}"}} '
                   f"{r.inflight}" for r in self.replicas]
+        lines += ["# TYPE kgct_router_retries_total counter",
+                  f"kgct_router_retries_total {self.retries_total}"]
         # Aggregate each healthy replica's engine metrics behind the single
         # front door (one scrape target for the whole DP group), labelled by
-        # replica so series do not collide.
+        # replica so series do not collide. Each per-replica fetch is bounded
+        # (metrics_timeout_s): one stalled replica must not hang the whole
+        # scrape — stragglers are skipped and counted instead.
         fetched = await asyncio.gather(
             *(self._fetch_metrics(r) for r in self.replicas if r.healthy),
             return_exceptions=True)
+        self.scrape_errors_total += sum(
+            1 for res in fetched if isinstance(res, BaseException))
+        lines += ["# TYPE kgct_router_metrics_scrape_errors_total counter",
+                  "kgct_router_metrics_scrape_errors_total "
+                  f"{self.scrape_errors_total}"]
         # Regroup by metric family: the text exposition format requires ONE
         # TYPE line per family with ALL its samples contiguous — appending
         # replicas' expositions sequentially interleaves families and strict
@@ -157,9 +245,10 @@ class Router:
         a TYPE line opens a family and subsequent samples whose base name is
         the family (or family + ``_suffix``, the summary/histogram
         ``_sum``/``_count``/``_bucket`` children) belong to it."""
-        async with self._session.get(f"{replica.url}/metrics",
-                                     timeout=aiohttp.ClientTimeout(total=5)
-                                     ) as resp:
+        async with self._session.get(
+                f"{replica.url}/metrics",
+                timeout=aiohttp.ClientTimeout(total=self.metrics_timeout_s)
+                ) as resp:
             text = await resp.text()
         label = f'replica="{replica.url}"'
         out = []
@@ -185,9 +274,11 @@ class Router:
 
     # -- proxying ------------------------------------------------------------
 
-    def _pick(self, exclude: Optional[set] = None) -> Optional[Replica]:
+    def _pick(self, exclude: Optional[set] = None,
+              include_unhealthy: bool = False) -> Optional[Replica]:
         healthy = [r for r in self.replicas
-                   if r.healthy and (not exclude or r.url not in exclude)]
+                   if (r.healthy or include_unhealthy)
+                   and (not exclude or r.url not in exclude)]
         if not healthy:
             return None
         least = min(r.inflight for r in healthy)
@@ -200,36 +291,71 @@ class Router:
         Only CONNECT-phase failures (replica down/unreachable) fail over to
         the next healthy replica — a request the upstream already received
         may be mid-generation there, and re-sending it would silently double
-        device work under exactly the overload that causes resets. Upstream
-        errors after the body was delivered return 502; after streaming to
-        the client started, the stream is terminated (truncation is the
-        signal). Client-side disconnects never count against the replica."""
+        device work under exactly the overload that causes resets. When
+        every healthy replica fails the connect phase, the whole set is
+        retried up to ``connect_retries`` more rounds with exponential
+        backoff. Upstream errors after the body was delivered return 502;
+        after streaming to the client started, the stream is terminated
+        (truncation is the signal) and the stall/death circuit-breaks the
+        replica. Client-side disconnects never count against the replica."""
         body = await request.read()
         tried: set[str] = set()
         last_err: Optional[Exception] = None
+        connect_failed = False
+        rounds = 0
         while True:
-            replica = self._pick(exclude=tried)
+            # Retry rounds (rounds > 0) ignore the healthy flag: the connect
+            # failures that triggered the retry are exactly what benched the
+            # replicas (fail_threshold), and a retry restricted to healthy
+            # ones would find nothing and give up — defeating its purpose of
+            # riding out a restart blip. Nothing reached any upstream, so a
+            # desperation probe of benched replicas is safe.
+            replica = self._pick(exclude=tried,
+                                 include_unhealthy=rounds > 0)
             if replica is None:
+                # Every candidate this round failed at connect: nothing was
+                # sent anywhere, so a bounded backed-off re-probe of the
+                # full set is safe (replicas restart in seconds under k8s).
+                if connect_failed and rounds < self.connect_retries and tried:
+                    await asyncio.sleep(
+                        self.retry_backoff_s * (2 ** rounds))
+                    rounds += 1
+                    tried.clear()
+                    connect_failed = False
+                    continue
                 break
             tried.add(replica.url)
             replica.inflight += 1
             try:
                 try:
+                    if _inject_fault("router_connect"):
+                        raise ConnectionRefusedError(
+                            "KGCT_FAULT router_connect")
                     upstream_cm = self._session.request(
                         request.method, f"{replica.url}{request.path_qs}",
                         data=body if body else None,
                         headers={k: v for k, v in request.headers.items()
                                  if k.lower() not in HOP_HEADERS})
-                    upstream = await upstream_cm.__aenter__()
-                except aiohttp.ClientConnectorError as e:
-                    # TCP connect failed: nothing reached the upstream —
-                    # safe to fail over.
+                    # Headers deadline: a replica that accepted the request
+                    # and then never responds at all is wedged — but the
+                    # bound is the generous response_timeout_s, because a
+                    # non-streaming completion legitimately sends nothing
+                    # until the whole generation finishes.
+                    upstream = await asyncio.wait_for(
+                        upstream_cm.__aenter__(), self.response_timeout_s)
+                except CONNECT_PHASE_ERRORS as e:
+                    # TCP connect failed or timed out: nothing reached the
+                    # upstream — safe to fail over.
                     last_err = e
+                    connect_failed = True
+                    self.retries_total += 1
                     self._count_failure(replica, e)
                     continue
-                except aiohttp.ClientError as e:
-                    # Request sent (at least partially) but no response: the
-                    # upstream may already be processing it — do NOT re-send.
+                except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                    # Request sent (at least partially) but no response —
+                    # including a replica that accepted the body then went
+                    # silent past stall_timeout_s: the upstream may already
+                    # be processing it — do NOT re-send.
                     last_err = e
                     self._count_failure(replica, e)
                     break
@@ -241,11 +367,23 @@ class Router:
                     await resp.prepare(request)
                     while True:
                         try:
-                            chunk = await upstream.content.readany()
-                        except aiohttp.ClientError as e:
-                            # Upstream died mid-stream: the replica is suspect;
-                            # the client stream is already committed —
-                            # terminate it (truncation is the signal).
+                            if _inject_fault("replica_hang"):
+                                raise asyncio.TimeoutError(
+                                    "KGCT_FAULT replica_hang")
+                            # Per-chunk stall deadline: once streaming, a
+                            # healthy engine emits tokens continuously —
+                            # stall_timeout_s of silence means the replica
+                            # hung mid-generation.
+                            chunk = await asyncio.wait_for(
+                                upstream.content.readany(),
+                                self.stall_timeout_s)
+                        except (aiohttp.ClientError,
+                                asyncio.TimeoutError) as e:
+                            # Upstream died or stalled mid-stream (no bytes
+                            # for stall_timeout_s): circuit-break the
+                            # replica; the client stream is already
+                            # committed — terminate it (truncation is the
+                            # signal).
                             self._count_failure(replica, e)
                             with contextlib.suppress(Exception):
                                 await resp.write_eof()
@@ -265,20 +403,21 @@ class Router:
             finally:
                 replica.inflight -= 1
         if last_err is not None:
-            return web.json_response(
-                {"error": {"message": f"upstream error: {last_err}",
-                           "code": 502}},
-                status=502)
-        return web.json_response(
-            {"error": {"message": "no healthy replicas", "code": 503}},
-            status=503)
+            return _proxy_error(502, f"upstream error: {last_err}",
+                                retry_after_s=1)
+        return _proxy_error(
+            503, "no healthy replicas; retry shortly",
+            retry_after_s=max(int(self.health_interval_s), 1))
 
     def _count_failure(self, replica: Replica, err: Exception) -> None:
         replica.consecutive_failures += 1
         if replica.consecutive_failures >= self.fail_threshold:
             replica.healthy = False
-            logger.warning("replica %s marked unhealthy (%s)",
-                           replica.url, err)
+            replica.benched_until = time.monotonic() + self.bench_cooldown_s
+            logger.warning("replica %s marked unhealthy for >= %.0fs (%s)",
+                           replica.url, self.bench_cooldown_s, err)
+
+
 
 
 def main(argv: Optional[list[str]] = None) -> None:

@@ -106,6 +106,26 @@ def test_engine_deployment_shape():
     assert {"/models/Qwen2.5-7B", "/dev/shm"} <= mount_paths
 
 
+def test_engine_pod_graceful_drain_contract():
+    """The deploy renderer must give the SIGTERM drain room to work: a
+    preStop sleep so endpoint removal outruns the signal, and a termination
+    grace period that outlasts the engine's default drain_grace_s (120 s)."""
+    ms = render_values(copy.deepcopy(VALUES))
+    pod = ms["qwen3-engine-deployment.yaml"]["spec"]["template"]["spec"]
+    c = pod["containers"][0]
+    pre_stop = c["lifecycle"]["preStop"]["exec"]["command"]
+    assert "sleep" in " ".join(pre_stop)
+    assert pod["terminationGracePeriodSeconds"] > 120
+    # The multihost StatefulSet template carries the same contract.
+    values = copy.deepcopy(VALUES)
+    values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"][
+        "pipelineParallelSize"] = 2
+    ms = render_values(values)
+    sts_pod = ms["qwen3-engine-statefulset.yaml"]["spec"]["template"]["spec"]
+    assert sts_pod["terminationGracePeriodSeconds"] > 120
+    assert "lifecycle" in sts_pod["containers"][0]
+
+
 def test_router_fronts_models():
     ms = render_values(copy.deepcopy(VALUES))
     router = ms["router-deployment.yaml"]

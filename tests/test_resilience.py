@@ -1,0 +1,315 @@
+"""Unit coverage for the resilience package: KGCT_FAULT grammar and
+determinism, admission-control estimates and shedding, the step watchdog
+state machine, drain transitions, loop liveness, and the histogram quantile
+the admission controller reads. Pure host-side logic — no engine, no jax."""
+
+import asyncio
+import math
+import time
+
+import pytest
+
+from kubernetes_gpu_cluster_tpu.observability.prometheus import Histogram
+from kubernetes_gpu_cluster_tpu.resilience import (AdmissionController,
+                                                   DrainState, FaultInjector,
+                                                   LoopLiveness,
+                                                   ResilienceHub,
+                                                   StepWatchdog,
+                                                   configure_faults, inject)
+from kubernetes_gpu_cluster_tpu.resilience.drain import (DRAINED, DRAINING,
+                                                         SERVING,
+                                                         drain_and_notify)
+from kubernetes_gpu_cluster_tpu.resilience.faults import fault_value
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    configure_faults(None)
+
+
+class TestFaultGrammar:
+    def test_multi_rule_spec(self):
+        inj = FaultInjector("replica_hang:p=1;step_stall:after=10,delay=0.5")
+        assert set(inj.rules) == {"replica_hang", "step_stall"}
+        assert inj.rules["step_stall"].after == 10
+        assert inj.rules["step_stall"].delay == 0.5
+
+    def test_bad_param_rejected(self):
+        with pytest.raises(ValueError, match="bad param"):
+            FaultInjector("step_stall:bogus=1")
+        with pytest.raises(ValueError, match="empty site"):
+            FaultInjector(":p=1")
+        with pytest.raises(ValueError, match="outside"):
+            FaultInjector("x:p=2")
+        with pytest.raises(ValueError, match="duplicate"):
+            FaultInjector("x:p=1;x:p=1")
+
+    def test_after_and_times(self):
+        inj = FaultInjector("site:after=2,times=2")
+        rule = inj.rules["site"]
+        fires = [rule.should_fire() for _ in range(6)]
+        # Skips the first 2 checks, fires exactly twice, then exhausted.
+        assert fires == [False, False, True, True, False, False]
+
+    def test_probability_deterministic_per_seed(self):
+        a = FaultInjector("s:p=0.5,seed=7").rules["s"]
+        b = FaultInjector("s:p=0.5,seed=7").rules["s"]
+        seq_a = [a.should_fire() for _ in range(32)]
+        seq_b = [b.should_fire() for _ in range(32)]
+        assert seq_a == seq_b                      # same seed, same sequence
+        assert any(seq_a) and not all(seq_a)       # actually probabilistic
+
+    def test_inject_unarmed_is_free(self):
+        configure_faults(None)
+        assert inject("anything") is False
+        assert fault_value("anything") is None
+
+    def test_configure_and_value(self):
+        configure_faults("queue_wait_est:value=12.5")
+        assert fault_value("queue_wait_est") == 12.5
+        configure_faults(None)
+        assert fault_value("queue_wait_est") is None
+
+
+class _FakeObs:
+    def __init__(self):
+        self.queue_wait = Histogram("kgct_queue_wait_seconds")
+        self.step_duration = Histogram("kgct_step_seconds")
+
+
+class _FakeScheduler:
+    def __init__(self, depth=0):
+        self.waiting = [object()] * depth
+
+
+class _FakeEngine:
+    def __init__(self, depth=0):
+        self.obs = _FakeObs()
+        self.scheduler = _FakeScheduler(depth)
+
+
+class TestAdmissionController:
+    def test_no_budget_admits_everything(self):
+        adm = AdmissionController(_FakeEngine(depth=100))
+        assert adm.check(None) is None
+        assert adm.shed_total == 0
+
+    def test_empty_queue_estimates_zero(self):
+        eng = _FakeEngine(depth=0)
+        eng.obs.queue_wait.observe(30.0)    # history says "slow"...
+        adm = AdmissionController(eng, default_budget_ms=100)
+        # ...but nothing is queued now: the next schedule admits immediately.
+        assert adm.estimate_queue_wait_s() == 0.0
+        assert adm.check(None) is None
+
+    def test_sheds_when_history_blows_budget(self):
+        eng = _FakeEngine(depth=4)
+        for _ in range(10):
+            eng.obs.queue_wait.observe(8.0)
+        adm = AdmissionController(eng, default_budget_ms=1000)
+        retry = adm.check(None)
+        assert retry is not None
+        assert 1 <= retry <= 60
+        assert adm.shed_total == 1
+        # An explicit generous budget is admitted.
+        assert adm.check(60_000) is None
+
+    def test_depth_term_leads_lagging_histogram(self):
+        eng = _FakeEngine(depth=50)
+        for _ in range(10):
+            eng.obs.step_duration.observe(0.2)   # 50 deep x 0.2 s/step = 10 s
+        adm = AdmissionController(eng, default_budget_ms=2000)
+        assert adm.check(None) is not None
+        assert adm.last_estimate_s >= 5.0
+
+    def test_fault_forced_estimate(self):
+        configure_faults("queue_wait_est:value=30")
+        adm = AdmissionController(_FakeEngine(depth=0),
+                                  default_budget_ms=1000)
+        retry = adm.check(None)
+        assert retry == 30
+        assert adm.last_estimate_s == 30.0
+
+    def test_windowed_quantile_forgets_old_overload(self):
+        """A past overload episode must stop inflating the estimate once it
+        leaves the sliding window — the lifetime histogram never decays, so
+        the controller differences bucket counts against a rotating
+        snapshot (and a recovered server stops shedding)."""
+        eng = _FakeEngine(depth=2)
+        for _ in range(50):
+            eng.obs.queue_wait.observe(8.0)      # the bad old days
+        adm = AdmissionController(eng, default_budget_ms=1000,
+                                  window_s=0.01)
+        assert adm.check(None) is not None       # history in first window
+        # Rotate past the episode: two rotations age it out entirely.
+        time.sleep(0.02)
+        adm.estimate_queue_wait_s()
+        time.sleep(0.02)
+        adm.estimate_queue_wait_s()
+        # Fresh window holds only fast waits now.
+        eng.obs.queue_wait.observe(0.01)
+        assert adm.check(None) is None
+        # New slow observations inside the current window count again.
+        for _ in range(50):
+            eng.obs.queue_wait.observe(8.0)
+        assert adm.check(None) is not None
+
+
+class TestHistogramQuantile:
+    def test_empty_is_zero(self):
+        assert Histogram("h").quantile(0.9) == 0.0
+
+    def test_interpolates_within_bucket(self):
+        h = Histogram("h", buckets=(1.0, 2.0, 4.0))
+        for _ in range(100):
+            h.observe(1.5)      # all in the (1, 2] bucket
+        q = h.quantile(0.5)
+        assert 1.0 < q <= 2.0
+
+    def test_merges_labelsets_and_clamps_tail(self):
+        h = Histogram("h", buckets=(1.0, 2.0), labels=("outcome",))
+        h.observe(0.5, ("finished",))
+        h.observe(100.0, ("aborted",))     # above last finite bound
+        assert h.quantile(0.99) == 2.0     # clamps to last finite bucket
+        assert h.count == 2 and h.sum == pytest.approx(100.5)
+
+    def test_monotone_in_q(self):
+        h = Histogram("h", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0):
+            h.observe(v)
+        qs = [h.quantile(q) for q in (0.1, 0.5, 0.9)]
+        assert qs == sorted(qs)
+
+
+class TestStepWatchdog:
+    def test_trip_and_recover(self):
+        trips = []
+        wd = StepWatchdog(timeout_s=0.01, on_trip=lambda: trips.append(1))
+        wd.arm()
+        time.sleep(0.03)
+        assert wd._check_once() is True
+        assert not wd.healthy and wd.trips == 1 and trips == [1]
+        # Same hung step does not double-count.
+        assert wd._check_once() is False
+        assert wd.trips == 1
+        # The step finally completes: health recovers.
+        wd.disarm()
+        assert wd.healthy
+
+    def test_no_trip_when_disarmed_or_fast(self):
+        wd = StepWatchdog(timeout_s=0.05)
+        assert wd._check_once() is False        # never armed
+        wd.arm()
+        assert wd._check_once() is False        # within deadline
+        wd.disarm()
+        assert wd.healthy and wd.trips == 0
+
+    def test_watcher_thread_lifecycle(self):
+        wd = StepWatchdog(timeout_s=0.02)
+        wd.start()
+        wd.start()      # idempotent
+        wd.arm()
+        deadline = time.monotonic() + 1.0
+        while wd.healthy and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert not wd.healthy and wd.trips >= 1
+        wd.disarm()
+        wd.stop()
+
+
+class TestDrain:
+    def test_state_machine(self):
+        d = DrainState()
+        assert d.state == SERVING and d.gauge_value == 0
+        assert not d.is_draining
+        assert d.start_drain() is True
+        assert d.start_drain() is False          # idempotent under SIGTERM x2
+        assert d.state == DRAINING and d.gauge_value == 1 and d.is_draining
+        d.mark_drained()
+        assert d.state == DRAINED and d.gauge_value == 2
+
+    def test_mark_drained_requires_draining(self):
+        d = DrainState()
+        d.mark_drained()
+        assert d.state == SERVING    # no-op outside a drain
+
+    def test_drain_and_notify_waits_for_idle(self):
+        class _Eng:
+            def __init__(self):
+                self.calls = 0
+
+            def has_unfinished_requests(self):
+                self.calls += 1
+                return self.calls < 3     # busy twice, then idle
+
+        class _Async:
+            def __init__(self):
+                self.engine = _Eng()
+
+        d = DrainState()
+        d.start_drain()
+        fired = []
+        asyncio.run(drain_and_notify(d, _Async(), grace_s=5.0,
+                                     on_drained=lambda: fired.append(1),
+                                     poll_s=0.01))
+        assert d.state == DRAINED and fired == [1]
+
+    def test_drain_grace_lapses(self):
+        class _Async:
+            class engine:            # noqa: N801 - attribute shim
+                @staticmethod
+                def has_unfinished_requests():
+                    return True      # never goes idle
+
+        d = DrainState()
+        d.start_drain()
+        t0 = time.monotonic()
+        asyncio.run(drain_and_notify(d, _Async(), grace_s=0.05, poll_s=0.01))
+        assert d.state == DRAINED
+        assert time.monotonic() - t0 < 1.0
+
+
+class TestLoopLiveness:
+    def test_starting_state_is_alive_indefinitely(self):
+        # Before the first beat the loop is STARTING (a follower waits for
+        # the leader's lazy connect, possibly minutes): never report dead.
+        lv = LoopLiveness(timeout_s=0.05)
+        time.sleep(0.08)
+        assert lv.alive() and lv.reason == ""
+
+    def test_beats_and_timeout(self):
+        lv = LoopLiveness(timeout_s=0.05)
+        lv.beat()
+        assert lv.alive() and lv.reason == ""
+        time.sleep(0.08)
+        assert not lv.alive()
+        assert "no heartbeat" in lv.reason
+        lv.beat()
+        assert lv.alive()
+
+    def test_mark_dead_is_terminal(self):
+        lv = LoopLiveness(timeout_s=10)
+        lv.mark_dead("leader gone")
+        assert not lv.alive() and lv.reason == "leader gone"
+        lv.beat()
+        assert not lv.alive()       # dead is dead until restart
+
+
+class TestResilienceHub:
+    def test_prometheus_lines(self):
+        adm = AdmissionController(_FakeEngine())
+        adm.shed_total = 3
+        wd = StepWatchdog()
+        wd.trips = 2
+        drain = DrainState()
+        drain.start_drain()
+        lines = ResilienceHub(adm, wd, drain).render_prometheus()
+        text = "\n".join(lines)
+        assert "kgct_requests_shed_total 3" in text
+        assert "kgct_watchdog_trips_total 2" in text
+        assert "kgct_drain_state 1" in text
+        # Every sample is a finite number (scrape-clean).
+        for line in lines:
+            if not line.startswith("#"):
+                assert math.isfinite(float(line.rsplit(" ", 1)[1]))

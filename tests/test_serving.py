@@ -3,7 +3,9 @@ router — end-to-end over the real engine on the CPU mesh (debug-tiny)."""
 
 import asyncio
 import json
+import time
 
+import aiohttp
 import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
@@ -399,8 +401,12 @@ class TestRouterFailover:
             # live replica
             async def ok(request):
                 return aioweb.json_response({"from": "live"})
+
+            async def health(request):
+                return aioweb.json_response({"status": "ok"})
             app = aioweb.Application()
             app.router.add_post("/v1/completions", ok)
+            app.router.add_get("/health", health)
             runner = aioweb.AppRunner(app)
             await runner.setup()
             site = aioweb.TCPSite(runner, "127.0.0.1", 0)
@@ -417,6 +423,11 @@ class TestRouterFailover:
             rsite = aioweb.TCPSite(rrunner, "127.0.0.1", 0)
             await rsite.start()
             rport = rrunner.addresses[0][1]
+            # The startup probe already benched the dead replica; this test
+            # is about the harder case — a replica that PASSED its probes and
+            # died just before the request — so put it back in rotation.
+            router.replicas[0].healthy = True
+            router.replicas[0].consecutive_failures = 0
             try:
                 async with aiohttp.ClientSession() as s:
                     async with s.post(
@@ -637,6 +648,72 @@ class TestSamplingTailAPI:
             assert r2.status == 400
             msg = (await r2.json())["error"]["message"]
             assert "presence_penalty" in msg
+        loop.run_until_complete(go())
+
+
+class TestClientDisconnectAborts:
+    """A client that goes away must not leave device work running: every
+    handler exit path calls engine.abort (previously asserted only by
+    comments). Requests here ask for FAR more tokens than the poll deadline
+    allows, so a missing abort fails the test instead of passing slowly."""
+
+    async def _wait_engine_idle(self, eng, deadline_s=8.0):
+        deadline = time.monotonic() + deadline_s
+        while eng.has_unfinished_requests():
+            assert time.monotonic() < deadline, (
+                "engine still has unfinished requests after client "
+                "disconnect — abort path leaked device work")
+            await asyncio.sleep(0.02)
+
+    def test_streaming_disconnect_aborts_engine_request(self, api_client):
+        loop, client = api_client
+
+        async def go():
+            eng = _SERVER["api"].engine.engine
+            r = await client.post("/v1/completions", json={
+                "prompt": "run forever", "max_tokens": 400,
+                "temperature": 0.0, "stream": True})
+            assert r.status == 200
+            async for line in r.content:
+                if line.decode().strip().startswith("data: "):
+                    break       # first token delivered: request is live
+            assert eng.has_unfinished_requests()
+            r.close()           # client vanishes mid-stream
+            await self._wait_engine_idle(eng)
+            # The server survives and keeps serving.
+            r2 = await client.post("/v1/completions", json={
+                "prompt": "still alive", "max_tokens": 4,
+                "temperature": 0.0})
+            assert r2.status == 200
+        loop.run_until_complete(go())
+
+    def test_n_gt_1_disconnect_aborts_all_subrequests(self, api_client):
+        loop, client = api_client
+
+        async def go():
+            eng = _SERVER["api"].engine.engine
+            with pytest.raises(asyncio.TimeoutError):
+                await client.post("/v1/completions", json={
+                    "prompt": [2, 8, 4], "max_tokens": 400,
+                    "temperature": 1.0, "seed": 3, "n": 2},
+                    timeout=aiohttp.ClientTimeout(total=0.5))
+            await self._wait_engine_idle(eng)
+        loop.run_until_complete(go())
+
+    def test_best_of_disconnect_aborts_all_candidates(self, api_client):
+        loop, client = api_client
+
+        async def go():
+            eng = _SERVER["api"].engine.engine
+            with pytest.raises(asyncio.TimeoutError):
+                await client.post("/v1/completions", json={
+                    "prompt": [2, 8], "max_tokens": 400,
+                    "temperature": 1.0, "seed": 7, "best_of": 3},
+                    timeout=aiohttp.ClientTimeout(total=0.5))
+            await self._wait_engine_idle(eng)
+            r = await client.post("/v1/completions", json={
+                "prompt": [2, 8], "max_tokens": 4, "temperature": 0.0})
+            assert r.status == 200
         loop.run_until_complete(go())
 
 
