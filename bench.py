@@ -30,6 +30,7 @@ throughput per model class, labeled as such in the output.
 
 from __future__ import annotations
 
+import argparse
 import gc
 import json
 import os
@@ -87,6 +88,25 @@ OVERLOAD_UTILIZATION = float(os.environ.get("KGCT_BENCH_OVERLOAD_UTIL", 1.3))
 OVERLOAD_REQUESTS = int(os.environ.get("KGCT_BENCH_OVERLOAD_REQS", 64))
 OVERLOAD_TTFT_BUDGET_MS = float(
     os.environ.get("KGCT_BENCH_TTFT_BUDGET_MS", 1000.0))
+# Stall-free mixed prefill/decode batching (engine/mixed_batch.py). Default
+# ON for the bench: the sustained-load phase is the north-star TTFT
+# measurement and mixing is the scheduler-level fix it exists to validate;
+# KGCT_BENCH_MIXED=0 runs the legacy prefill-else-decode policy (A/B).
+MIXED_BATCH = os.environ.get("KGCT_BENCH_MIXED", "1") != "0"
+
+# The stdout contract bench.py guarantees (also the --help epilog, and what
+# tests/test_bench_contract.py pins): everything before the last line is
+# free-form noise; the LAST non-empty stdout line is the result.
+OUTPUT_CONTRACT = """\
+Output contract (the driver's official record depends on it):
+
+  The LAST non-empty line of stdout is the benchmark result — exactly one
+  single-line JSON object (json.dumps, no embedded newlines), written and
+  flushed after everything else. All logging goes to stderr; any earlier
+  stdout noise is flushed before the result so interleaving cannot split
+  the line. Consumers must parse ONLY that last line (parse_result_line()
+  implements this), never scan stdout for something JSON-shaped.
+"""
 
 
 def _mk_engine(model_name: str, quant, batch: int, max_new: int,
@@ -102,7 +122,7 @@ def _mk_engine(model_name: str, quant, batch: int, max_new: int,
         scheduler=SchedulerConfig(
             max_num_seqs=batch, max_prefill_tokens=budget,
             decode_buckets=(batch,), prefill_buckets=(budget,),
-            decode_window=window))
+            decode_window=window, mixed_batch_enabled=MIXED_BATCH))
     return LLMEngine(cfg, eos_token_id=None)
 
 
@@ -187,6 +207,54 @@ def _roofline(mcfg, quant, batch: int, ctx: int) -> dict:
         "kv_bytes_per_step": int(batch * kv_token_bytes * ctx),
         "step_bytes": int(step_bytes),
         "flops_per_token": int(flops_per_token),
+    }
+
+
+def _roofline_prefill(mcfg, quant, T: int) -> dict:
+    """Modeled ragged-prefill step of ``T`` flattened prompt tokens — the
+    arithmetic target TTFT optimization regresses against (ROADMAP item #5:
+    the roofline used to model decode only while prefill was the weak
+    phase).
+
+    FLOPs: every matmul runs over all T tokens (2 FLOPs/MAC, routed experts
+    only for MoE) plus causal attention score+value FLOPs (~T^2/2 valid
+    pairs). Logits project only the B sampled rows, not T — excluded, like
+    the decode model excludes sampling. Bytes: the weight stream (every
+    matmul weight once per step — amortized over T, which is why prefill is
+    compute-bound where decode is weight-streaming-bound) plus the step's
+    KV writes; activations are omitted (VMEM-resident at these shapes).
+    ``flops_per_byte`` makes the regime explicit: compared against the
+    chip's peak FLOPs/peak bandwidth ratio (~240 on v5e), prefill at
+    budget-sized T sits far above it — any TTFT prefill-phase time beyond
+    ``compute_bound_ms`` is overhead (padding, layout, host), not physics.
+    """
+    h, inter = mcfg.hidden_size, mcfg.intermediate_size
+    nh, nkv, hd = mcfg.num_heads, mcfg.num_kv_heads, mcfg.head_dim
+    L = mcfg.num_layers
+    dtype_bytes = 2 if mcfg.dtype == "bfloat16" else 4
+    wbytes = 1 if quant == "int8" else dtype_bytes
+
+    attn_p = h * nh * hd + 2 * h * nkv * hd + nh * hd * h
+    mlp_unit = 3 * h * inter
+    n_exp = max(mcfg.num_experts, 1)
+    active_exp = mcfg.num_experts_per_tok if mcfg.is_moe else 1
+    layer_streamed = attn_p + n_exp * mlp_unit
+    layer_active = attn_p + active_exp * mlp_unit
+
+    matmul_flops = 2 * T * L * layer_active
+    attn_flops = 4 * L * nh * hd * (T * T) // 2     # causal: ~half the pairs
+    flops_step = matmul_flops + attn_flops
+    kv_token_bytes = 2 * L * nkv * hd * 2           # bf16 KV
+    bytes_step = L * layer_streamed * wbytes + T * kv_token_bytes
+    return {
+        "tokens_modeled": int(T),
+        "flops_per_step": int(flops_step),
+        "flops_per_token": int(flops_step // max(T, 1)),
+        "bytes_per_step": int(bytes_step),
+        "flops_per_byte": round(flops_step / bytes_step, 1),
+        "compute_bound_ms": round(
+            flops_step / (CHIP_TFLOPS_BF16 * 1e12) * 1e3, 3),
+        "hbm_bound_ms": round(bytes_step / (CHIP_HBM_GBPS * 1e9) * 1e3, 3),
     }
 
 
@@ -428,6 +496,21 @@ def run_config(model_name: str, quant, batch: int, *, sustained: bool,
         engine.step()
     for _ in range(WARMUP_WINDOWS):
         engine.step()
+    if MIXED_BATCH and batch > 1:
+        # Compile the MIXED step program at the sustained-phase shape (one
+        # fresh prompt riding a near-full decode batch) so its first-use
+        # XLA compile cannot land inside the measured load phases and
+        # poison the TTFT percentiles the mixing exists to improve. One
+        # warm seat is freed first: a final chunk is only admitted when a
+        # max_num_seqs seat is open, which is also the only regime where
+        # sustained-phase mixing fires. Up to 3 steps: one drains the
+        # in-flight decode chain, one runs the mixed step.
+        engine.abort_request("warm-0")
+        _add_batch(engine, rng, vocab, "warmmix", 1, max_new)
+        for _ in range(3):
+            if engine.scheduler.waiting:
+                engine.step()
+        engine.abort_request("warmmix-0")
     _drain(engine, "warm", batch)
 
     prefill, live_tag = _measure_prefill_ttft(
@@ -445,6 +528,17 @@ def run_config(model_name: str, quant, batch: int, *, sustained: bool,
     mcfg = engine.config.model
     acct = _roofline(mcfg, quant, batch, ctx_mid)
     util = _utilization(acct, greedy_rate, batch)
+    # Prefill roofline at the measured operating point: one budget-bounded
+    # ragged step (the whole fresh batch when it fits the budget). The
+    # measured rate's utilization against the compute bound is prefill's
+    # "mfu" — the TTFT arithmetic target.
+    pf_tokens = min(budget, batch * PROMPT_LEN)
+    pf = _roofline_prefill(mcfg, quant, pf_tokens)
+    pf_rate = prefill["prefill_tokens_per_sec"]
+    if pf_rate and pf_rate == pf_rate:
+        pf["prefill_mfu"] = round(
+            pf_rate * pf["flops_per_token"] / (CHIP_TFLOPS_BF16 * 1e12), 4)
+        pf["measured_step_ms"] = round(pf_tokens / pf_rate * 1e3, 1)
     # Observability readout: median queue/prefill/first-fetch TTFT split and
     # the per-phase step-time attribution accumulated over the whole run —
     # a TTFT or tok/s regression in a future round decomposes into a phase
@@ -475,6 +569,7 @@ def run_config(model_name: str, quant, batch: int, *, sustained: bool,
         **prefill,
         "ttft_decomposition": ttft_decomp,
         "step_phase_breakdown": phase_breakdown,
+        "mixed_batch": MIXED_BATCH,
         "roofline": {
             "chip": {"hbm_gbps_peak": CHIP_HBM_GBPS,
                      "tflops_bf16_peak": CHIP_TFLOPS_BF16},
@@ -482,6 +577,7 @@ def run_config(model_name: str, quant, batch: int, *, sustained: bool,
             **{k: acct[k] for k in ("weight_stream_bytes", "kv_bytes_per_step",
                                     "flops_per_token")},
             **util,
+            "prefill": pf,
         },
     }
     if sustained and greedy_rate > 0:
@@ -492,10 +588,18 @@ def run_config(model_name: str, quant, batch: int, *, sustained: bool,
         for dq in (engine.obs.ttft_queue_s, engine.obs.ttft_prefill_s,
                    engine.obs.ttft_fetch_s):
             dq.clear()
+        kinds_before = dict(engine.obs.step_kind_counts)
         result["sustained_load"] = _measure_sustained(
             engine, rng, vocab, batch, rate_rps)
         result["sustained_load"]["ttft_decomposition"] = (
             engine.obs.ttft_decomposition())
+        # Windowed mixed-step ratio for THIS phase (the whole-run gauge is
+        # diluted by the fresh-batch phases, which rarely mix).
+        deltas = {k: engine.obs.step_kind_counts[k] - kinds_before[k]
+                  for k in kinds_before}
+        total = sum(deltas.values())
+        result["sustained_load"]["mixed_step_ratio"] = (
+            round(deltas["mixed"] / total, 3) if total else None)
         over_rps = OVERLOAD_UTILIZATION * greedy_rate / LOAD_MAX_NEW
         # Budget floor: 2x the measured fresh-batch TTFT p50. Admission
         # control sheds QUEUE wait; it cannot (and should not) shed the
@@ -507,6 +611,11 @@ def run_config(model_name: str, quant, batch: int, *, sustained: bool,
                      if floor == floor else OVERLOAD_TTFT_BUDGET_MS)
         result["overload"] = _measure_overload(
             engine, rng, vocab, over_rps, budget_ms)
+    # Whole-run mixed-step ratio, read LAST so the sustained/overload phases
+    # (where mixing actually engages) are included.
+    ratio = engine.obs.mixed_step_ratio()
+    result["mixed_step_ratio"] = (round(ratio, 3) if ratio is not None
+                                  else None)
     del engine
     gc.collect()
     return result
@@ -542,8 +651,57 @@ def assemble_output(results: list[dict], backend: str) -> dict:
         # first-step fetch medians) surfaced top-level for the driver.
         "ttft_decomposition": primary.get("ttft_decomposition"),
         "sampled_over_greedy": primary.get("sampled_over_greedy"),
+        "mixed_batch": primary.get("mixed_batch"),
         "configs": results,
     }
+
+
+def parse_result_line(stdout_text: str) -> dict:
+    """Parse a bench run's result from its captured stdout — the inverse of
+    ``emit_result`` and the ONLY supported way to consume a transcript.
+    Takes the last non-empty line (trailing whitespace/newlines tolerated;
+    any amount of earlier noise ignored) and json.loads it, raising
+    ValueError with context instead of returning None — the r5 official
+    record landed ``"parsed": null`` because a driver-side parser failed
+    silently."""
+    lines = [ln for ln in stdout_text.splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError("empty bench stdout: no result line to parse")
+    last = lines[-1].strip()
+    try:
+        out = json.loads(last)
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"last stdout line is not the bench result JSON "
+            f"(contract: see bench.py --help): {last[:200]!r}") from e
+    if not isinstance(out, dict):
+        raise ValueError(f"bench result line parsed to {type(out).__name__}, "
+                         "expected a JSON object")
+    return out
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    """--help documents the stdout contract; configuration itself stays on
+    KGCT_BENCH_* env vars (listed here) so the driver's invocation is just
+    ``python bench.py``."""
+    p = argparse.ArgumentParser(
+        prog="bench.py",
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        description=(
+            "Serving benchmark: prefill/TTFT, greedy+sampled decode, "
+            "roofline (decode + prefill), sustained-load and overload "
+            "phases.\n\n" + OUTPUT_CONTRACT),
+        epilog=(
+            "Configuration (env vars): KGCT_BENCH_MODEL, KGCT_BENCH_QUANT, "
+            "KGCT_BENCH_BATCH, KGCT_BENCH_WINDOW, KGCT_BENCH_PREFILL_BUDGET, "
+            "KGCT_BENCH_WINDOWS, KGCT_BENCH_SAMPLED_WINDOWS, "
+            "KGCT_BENCH_LOAD_REQS, KGCT_BENCH_LOAD_UTIL, "
+            "KGCT_BENCH_OVERLOAD_UTIL, KGCT_BENCH_OVERLOAD_REQS, "
+            "KGCT_BENCH_TTFT_BUDGET_MS, KGCT_BENCH_MIXED (1=stall-free "
+            "mixed prefill/decode batching, default on; 0=legacy "
+            "prefill-else-decode), KGCT_BENCH_PROMPT, KGCT_BENCH_PAGE, "
+            "KGCT_CHIP_HBM_GBPS, KGCT_CHIP_TFLOPS_BF16."))
+    return p
 
 
 def emit_result(out: dict) -> None:
@@ -561,6 +719,7 @@ def emit_result(out: dict) -> None:
 
 
 def main() -> None:
+    build_arg_parser().parse_args()   # --help / reject unknown args
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     rng = np.random.default_rng(0)

@@ -51,6 +51,19 @@ class SchedulerConfig:
     # prompts' full KV pages are content-addressed and reused by later
     # requests sharing a page-aligned prefix (engine/kv_cache.PrefixCache).
     enable_prefix_caching: bool = False
+    # Stall-free mixed prefill/decode batching (Sarathi-Serve-style): when
+    # running decodes and waiting prefill work coexist, one device step
+    # carries every running sequence's decode token PLUS a budgeted chunk of
+    # the queue-head prompt — prefills no longer stall decode and decode no
+    # longer starves prefill (engine/mixed_batch.py). Off by default: the
+    # legacy prefill-else-decode policy is the behavioral baseline; serving
+    # enables it via --enable-mixed-batch, bench via KGCT_BENCH_MIXED=1.
+    mixed_batch_enabled: bool = False
+    # Per-mixed-step token budget. Decode rows claim their tokens FIRST
+    # (decode is never dropped from a mixed step); the head prompt's chunk
+    # fills the remainder, still capped by max_prefill_tokens. None = use
+    # max_prefill_tokens as the mixed budget.
+    decode_priority_token_budget: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -102,6 +102,12 @@ def _engine_args(spec: dict) -> list[str]:
         args += ["--max-model-len", str(cfg["maxModelLen"])]
     if cfg.get("enablePrefixCaching"):
         args += ["--enable-prefix-caching"]
+    if cfg.get("enableMixedBatch"):
+        # Stall-free mixed prefill/decode batching (the TTFT QoS lever).
+        args += ["--enable-mixed-batch"]
+        if cfg.get("decodePriorityTokenBudget") is not None:
+            args += ["--decode-priority-token-budget",
+                     str(cfg["decodePriorityTokenBudget"])]
     # enableChunkedPrefill needs no flag: long prompts always chunk here.
     if os.path.isabs(str(spec["modelURL"])):
         # Local checkpoint dir (hostPath-mounted): weights + tokenizer live

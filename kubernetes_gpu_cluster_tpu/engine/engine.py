@@ -30,7 +30,7 @@ import numpy as np
 from ..config import EngineConfig
 from ..models import llama as model_lib
 from ..observability import Observability
-from ..models.llama import DecodeMeta, PrefillMeta
+from ..models.llama import DecodeMeta, MixedMeta, PrefillMeta
 from ..ops.sampling import (apply_logit_bias, apply_penalties, build_counts,
                             bump_counts, gated_top_logprobs, row_sample_keys,
                             sample_and_logprobs, token_logprobs)
@@ -205,6 +205,41 @@ class LLMEngine:
         # longer than max_prefill_tokens take this path; parity locked in by
         # tests/test_parallel.py::test_pp_engine_chunked_prefill).
         self._prefill_hist_fn = self._build_prefill_hist_fn()
+        # Mixed prefill/decode step program (stall-free batching). No pp/sp
+        # variant exists: the pipelined layer regime and ring attention both
+        # replace the kernels this path splits the token axis between, so
+        # those meshes keep the legacy prefill-else-decode policy.
+        if self.pp_size == 1 and self.sp_size == 1:
+            self._mixed_fn = self._build_mixed_fn()
+        else:
+            self._mixed_fn = None
+            if self.scheduler.mixed_enabled:
+                logger.warning(
+                    "mixed batching disabled: no mixed forward path under "
+                    "pp=%d/sp=%d meshes", self.pp_size, self.sp_size)
+                self.scheduler.mixed_enabled = False
+        if self.scheduler.mixed_enabled:
+            # Surface configurations that silently leave mixing inert: the
+            # bow-out probes in build_mixed_batch read ~0 on
+            # kgct_mixed_step_ratio with no other signal.
+            sc = config.scheduler
+            budget = sc.decode_priority_token_budget
+            if budget is not None and budget < 2:
+                raise ValueError(
+                    f"decode_priority_token_budget={budget} can never fit a "
+                    "decode row plus a chunk token; mixing would never engage")
+            if budget is not None and budget < sc.max_num_seqs + 1:
+                logger.warning(
+                    "mixed batching: decode_priority_token_budget=%d is below"
+                    " max_num_seqs+1=%d — a full batch's decode rows alone "
+                    "exhaust it, so high-occupancy steps keep the legacy "
+                    "policy", budget, sc.max_num_seqs + 1)
+            if sc.max_num_seqs > sc.decode_buckets[-1]:
+                logger.warning(
+                    "mixed batching: max_num_seqs=%d exceeds the decode "
+                    "bucket grid (max %d); steps with more running sequences"
+                    " than the grid covers keep the legacy policy",
+                    sc.max_num_seqs, sc.decode_buckets[-1])
         self.stats = EngineStats()
         self.step_count = 0
         # Speculative decode-window chain state (see step()).
@@ -538,6 +573,53 @@ class LLMEngine:
 
         return self._maybe_jit(prefill_hist_step, donate_argnums=(1,))
 
+    def _build_mixed_fn(self):
+        """Mixed prefill/decode step (models.forward_mixed): ONE program
+        runs a budgeted chunk of the queue-head prompt AND every running
+        sequence's decode token. Compiled per (prefill bucket, row bucket,
+        history width) — the same bounded bucket grid as the pure paths
+        (tests/test_compile_guard.py pins the bound). Penalties use the
+        host-resync histogram (out_tokens) like the chunked path: mixed
+        steps sync every step, so the host always knows the full output
+        history. Sampling rows cover the decode rows plus the chunk's last
+        token; the engine discards the chunk row's sample when the chunk is
+        partial (KV committed, prompt unfinished)."""
+        cfg = self.model_config
+        use_pallas = self.use_pallas
+        use_pallas_hist = self.use_pallas_hist
+        attn_mesh = self._gspmd_attn_mesh()
+
+        def mixed_step(params, kv: KVCache, int_t, int_b, float_b,
+                       chunk_page_table, hist_len, page_tables, context_lens,
+                       out_tokens, bias_ids, bias_vals, key):
+            # int_t: [4, Tp_bucket + R_pad]; int_b: [R_pad, 5] =
+            # (logits_indices, top_k, seed, prompt_len, top_n).
+            meta = MixedMeta(
+                seg_ids=int_t[1], positions=int_t[2], slot_mapping=int_t[3],
+                logits_indices=int_b[:, 0], chunk_page_table=chunk_page_table,
+                hist_len=hist_len, page_tables=page_tables,
+                context_lens=context_lens)
+            hidden, kv, _ = model_lib.forward_mixed(
+                params, cfg, int_t[0], meta, kv, use_pallas=use_pallas,
+                use_pallas_hist=use_pallas_hist, attn_mesh=attn_mesh)
+            logits = model_lib.compute_logits(params, cfg, hidden)
+            logits = _maybe_bias(logits, bias_ids, bias_vals)
+            presence, frequency = float_b[:, 2], float_b[:, 3]
+            logits = jax.lax.cond(
+                jnp.any((presence != 0.0) | (frequency != 0.0)),
+                lambda l: apply_penalties(
+                    l, build_counts(out_tokens, cfg.vocab_size),
+                    presence, frequency),
+                lambda l: l, logits)
+            pos_next = jnp.take(int_t[2], int_b[:, 0]) + 1
+            keys = row_sample_keys(key, int_b[:, 2], pos_next)
+            next_tokens, lps, tids, tlps = sample_and_logprobs(
+                logits, keys, float_b[:, 0], int_b[:, 1], float_b[:, 1],
+                row_keys=True, with_top=jnp.any(int_b[:, 4] > 0))
+            return next_tokens, lps, tids, tlps, kv
+
+        return self._maybe_jit(mixed_step, donate_argnums=(1,))
+
     def _build_decode_fn(self, greedy: bool = False):
         """Multi-step decode: W autoregressive steps inside one XLA program.
         Sampled tokens feed back on-device through a lax.scan; per-sub-step
@@ -764,11 +846,14 @@ class LLMEngine:
         if info is None:
             self.obs.phases.discard_step()
         else:
-            kind, bsize, mode = info
+            # Mixed steps extend the info tuple with their per-step
+            # prefill/decode token split (the stall-free batching signal).
+            kind, bsize, mode = info[:3]
+            pf_tok, dc_tok = (info[3], info[4]) if len(info) > 3 else (0, 0)
             self.obs.on_step(
                 step=self.step_count, kind=kind, batch=bsize, duration_s=dt,
                 new_tokens=sum(len(o.new_token_ids or []) for o in outs),
-                mode=mode)
+                mode=mode, prefill_tokens=pf_tok, decode_tokens=dc_tok)
         return outs
 
     def _step(self) -> list[RequestOutput]:
@@ -797,6 +882,8 @@ class LLMEngine:
                 float_b = jnp.asarray(np.stack(
                     [batch.temperature, batch.top_p, batch.presence,
                      batch.frequency], axis=1))
+            if batch.kind == "mixed":
+                return drained + self._step_mixed(batch, float_b, step_key)
             if batch.kind == "prefill":
                 with ph("host_prep"):
                     int_t = jnp.asarray(np.stack(
@@ -893,6 +980,66 @@ class LLMEngine:
             "decode", inflight["batch"].num_seqs,
             "greedy" if inflight.get("greedy") else "sampled")
         return outputs
+
+    def _step_mixed(self, batch: ScheduledBatch, float_b,
+                    step_key) -> list[RequestOutput]:
+        """Execute one mixed step and commit its results: every decode row's
+        sampled token appends (with stop checks), the chunk's KV is
+        committed by the program itself, and the chunk row's sampled token
+        is the sequence's first generated token on a FINAL chunk — or
+        discarded (zombie row) when the prompt is still partial, exactly
+        like the solo chunked-prefill path. Mixed steps are synchronous
+        (no speculative chaining: the next step's batch composition depends
+        on this one's chunk progress), so finished rows release pages
+        immediately."""
+        ph = self.obs.phases.phase
+        chunk_seq = batch.seqs[-1]
+        with ph("host_prep"):
+            int_t = jnp.asarray(np.stack(
+                [batch.tokens, batch.seg_ids, batch.positions,
+                 batch.slot_mapping]))
+            int_b = jnp.asarray(np.stack(
+                [batch.logits_indices, batch.top_k, batch.seed,
+                 batch.prompt_lens, batch.top_n], axis=1))
+            chunk_pt = jnp.asarray(batch.chunk_page_table)
+            page_tables = jnp.asarray(batch.page_tables)
+            context_lens = jnp.asarray(batch.context_lens)
+            out_tokens = self._penalty_out_tokens(batch)
+            bias_ids, bias_vals = self._bias_arrays(batch)
+        self.stats.prefill_tokens += batch.prefill_token_count
+        with ph("device_dispatch"):
+            (next_tokens, lps, tids, tlps, self.kv_cache) = self._mixed_fn(
+                self.params, self.kv_cache, int_t, int_b, float_b, chunk_pt,
+                jnp.int32(batch.hist_len), page_tables, context_lens,
+                out_tokens, bias_ids, bias_vals, step_key)
+        with ph("device_fetch"):
+            # Same compute/transfer split as the prefill path: the TTFT
+            # decomposition's "prefill" carries the device compute, and
+            # "first_fetch" only the device->host copy.
+            t0f = time.perf_counter()
+            next_tokens.block_until_ready()
+            compute_s = time.perf_counter() - t0f
+            toks_np = np.asarray(next_tokens)[:, None]
+            lps_np = np.asarray(lps)[:, None]
+            top_i = top_l = None
+            if any(s.params.top_logprobs for s in batch.seqs):
+                top_i = np.asarray(tids)[:, None]
+                top_l = np.asarray(tlps)[:, None]
+        self._ttft_transfer_s = max(
+            self.obs.phases.current_durs.get("device_fetch", 0.0)
+            - compute_s, 0.0)
+        # A partial chunk's sampled row is meaningless (prompt unfinished):
+        # route it through the zombie set so _process_window skips it with
+        # no output, no stats, no stop checks.
+        zombies = {chunk_seq.request_id} if batch.partial else set()
+        with ph("postproc"):
+            outs = self._process_window(batch, toks_np, lps_np, zombies,
+                                        defer=False, top_ids=top_i,
+                                        top_lps=top_l)
+        self._last_step_info = ("mixed", batch.num_seqs, None,
+                                batch.prefill_token_count,
+                                batch.num_seqs - 1)
+        return outs
 
     def _bias_arrays(self, batch: ScheduledBatch):
         """(bias_ids [B, 300] i32 -1-padded, bias_vals [B, 300] f32) for the

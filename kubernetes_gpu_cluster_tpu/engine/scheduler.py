@@ -39,16 +39,18 @@ logger = get_logger("scheduler")
 @dataclasses.dataclass
 class ScheduledBatch:
     """One device step's worth of work, already laid out as padded numpy
-    arrays matching models.PrefillMeta / models.DecodeMeta."""
-    kind: str                      # "prefill" | "decode"
-    seqs: list[Sequence]           # the B real sequences (unpadded count)
-    tokens: np.ndarray             # prefill: [T]; decode: [B_pad]
+    arrays matching models.PrefillMeta / models.DecodeMeta / models.MixedMeta."""
+    kind: str                      # "prefill" | "decode" | "mixed"
+    seqs: list[Sequence]           # the B real sequences (unpadded count);
+                                   # mixed: decode seqs then the chunk seq last
+    tokens: np.ndarray             # prefill: [T]; decode: [B_pad];
+                                   # mixed: [Tp_bucket + R_pad]
     positions: np.ndarray
     slot_mapping: np.ndarray
-    # prefill only
+    # prefill + mixed
     seg_ids: Optional[np.ndarray] = None
     logits_indices: Optional[np.ndarray] = None   # [B_pad]
-    # decode only
+    # decode + mixed (decode rows)
     page_tables: Optional[np.ndarray] = None      # [B_pad, pages_bucket]
     context_lens: Optional[np.ndarray] = None     # [B_pad]
     # chunked prefill only (solo batch): history length + this seq's pages
@@ -56,6 +58,10 @@ class ScheduledBatch:
     # after this chunk (the sampled token is discarded).
     hist_len: Optional[int] = None
     partial: bool = False
+    # mixed only: the chunk sequence's page table (history attention) and
+    # the actual (unpadded) chunk token count for stats/observability.
+    chunk_page_table: Optional[np.ndarray] = None  # [1, hist_width]
+    prefill_token_count: int = 0
     # sampling arrays [B_pad]
     temperature: Optional[np.ndarray] = None
     top_k: Optional[np.ndarray] = None
@@ -90,6 +96,10 @@ class Scheduler:
         sc = config.scheduler
         self.max_num_seqs = sc.max_num_seqs
         self.max_prefill_tokens = sc.max_prefill_tokens
+        # Stall-free mixed prefill/decode batching (engine/mixed_batch.py).
+        # The engine may clear this after construction when the mesh regime
+        # has no mixed forward path (pp/sp).
+        self.mixed_enabled = sc.mixed_batch_enabled
         self.decode_buckets = sc.decode_buckets
         self.prefill_buckets = sc.prefill_buckets
         self.page_size = config.cache.page_size
@@ -195,6 +205,16 @@ class Scheduler:
     # -- scheduling ---------------------------------------------------------
 
     def schedule(self) -> Optional[ScheduledBatch]:
+        # Stall-free mixing: when running decodes and waiting prefill work
+        # coexist, one device step carries both (engine/mixed_batch.py).
+        # Every other state — and every case mixing cannot serve (no budget
+        # room, no pages for the chunk, batch full) — falls through to the
+        # legacy prefill-else-decode policy unchanged.
+        if self.mixed_enabled and self.running and self.waiting:
+            from .mixed_batch import build_mixed_batch
+            batch = build_mixed_batch(self)
+            if batch is not None:
+                return batch
         batch = self._schedule_prefills()
         if batch is not None:
             return batch
@@ -343,14 +363,7 @@ class Scheduler:
         page_arr = np.asarray(seq.pages, np.int64)
         slot_mapping[:chunk] = (page_arr[tok_pos // self.page_size] *
                                 self.page_size + tok_pos % self.page_size)
-        # History table width buckets to the ACTUAL context (few power-of-2
-        # compile shapes), not the model cap — the attention materializes
-        # [heads, T, width*ps] scores, so a max-len-wide table would make
-        # every small chunk pay max-model-len memory/FLOPs.
-        max_pages = cdiv(self.config.effective_max_len, self.page_size)
-        width = min(next_power_of_2(max(len(seq.pages), 1)), max_pages)
-        page_table = np.zeros((1, width), np.int32)
-        page_table[0, :len(seq.pages)] = seq.pages
+        page_table = self._chunk_page_table(seq)
         B = _bucket(1, self.decode_buckets)
         logits_indices = np.zeros(B, np.int32)
         logits_indices[0] = chunk - 1
@@ -380,6 +393,34 @@ class Scheduler:
             logits_indices=logits_indices, page_tables=page_table,
             hist_len=hist_len, partial=partial,
             **self._sampling_arrays([seq], B))
+
+    def _chunk_page_table(self, seq: Sequence) -> np.ndarray:
+        """[1, width] page table for a chunk's history attention. Width
+        buckets to the ACTUAL context (few power-of-2 compile shapes), not
+        the model cap — the attention materializes [heads, T, width*ps]
+        scores, so a max-len-wide table would make every small chunk pay
+        max-model-len memory/FLOPs. Single source for the solo-chunk and
+        mixed paths so their compile-shape families cannot diverge."""
+        max_pages = cdiv(self.config.effective_max_len, self.page_size)
+        width = min(next_power_of_2(max(len(seq.pages), 1)), max_pages)
+        table = np.zeros((1, width), np.int32)
+        table[0, :len(seq.pages)] = seq.pages
+        return table
+
+    def _fill_decode_row(self, seq: Sequence, row: int, offset: int,
+                         tokens, positions, slot_mapping,
+                         page_tables, context_lens) -> None:
+        """One decode row's step inputs (token slot ``offset + row``, table
+        row ``row``): shared by the pure decode and mixed layouts."""
+        pos = seq.num_tokens - 1
+        tokens[offset + row] = (seq.output_token_ids[-1]
+                                if seq.output_token_ids
+                                else seq.prompt_token_ids[-1])
+        positions[offset + row] = pos
+        slot_mapping[offset + row] = (seq.pages[pos // self.page_size] *
+                                      self.page_size + pos % self.page_size)
+        page_tables[row, :len(seq.pages)] = seq.pages
+        context_lens[row] = seq.num_tokens
 
     def _try_prefix_reuse(self, seq: Sequence) -> None:
         """Prefix-cache reuse rides the chunked-prefill machinery: a cached
@@ -411,13 +452,14 @@ class Scheduler:
             self.prefix_cache.register(seq.prompt_token_ids,
                                        seq.pages[:full])
 
-    def _schedule_decode(self) -> Optional[ScheduledBatch]:
-        if not self.running:
-            return None
-        # Ensure every running seq has pages covering the whole multi-step
-        # decode window (the device writes W new KV entries before the host
-        # sees any token); preempt the youngest until the rest fit.
-        W = self.config.scheduler.decode_window
+    def _grow_decode_pages(self, window: int) -> list[Sequence]:
+        """Ensure every running seq has pages covering a ``window``-step
+        decode (the device writes ``window`` new KV entries before the host
+        sees any token); preempt the youngest until the rest fit. Returns
+        the sequences whose pages now cover the window — the decode rows of
+        this step. Shared by the pure decode path (window = decode_window)
+        and the mixed path (window = 1: mixed steps advance decode by one
+        token, since the chunk in the same program runs once)."""
         scheduled: list[Sequence] = []
         idx = 0
         while idx < len(self.running):
@@ -425,7 +467,7 @@ class Scheduler:
             # Window inputs occupy positions num_tokens-1 .. num_tokens+W-2
             # (see Sequence.last_window_pos for the clamp rationale).
             last_pos = seq.last_window_pos(
-                seq.num_tokens - 1, W, self.config.effective_max_len)
+                seq.num_tokens - 1, window, self.config.effective_max_len)
             pages_needed = cdiv(last_pos + 1, self.page_size)
             grow = pages_needed - len(seq.pages)
             if grow > 0:
@@ -437,6 +479,12 @@ class Scheduler:
                     continue  # retry same index (list shrank from the back)
             scheduled.append(seq)
             idx += 1
+        return scheduled
+
+    def _schedule_decode(self) -> Optional[ScheduledBatch]:
+        if not self.running:
+            return None
+        scheduled = self._grow_decode_pages(self.config.scheduler.decode_window)
         if not scheduled:
             return None
 
@@ -452,15 +500,8 @@ class Scheduler:
         page_tables = np.zeros((B, pages_bucket), np.int32)
         context_lens = np.zeros(B, np.int32)
         for s, seq in enumerate(scheduled):
-            last = (seq.output_token_ids[-1] if seq.output_token_ids
-                    else seq.prompt_token_ids[-1])
-            pos = seq.num_tokens - 1
-            tokens[s] = last
-            positions[s] = pos
-            slot_mapping[s] = (seq.pages[pos // self.page_size] * self.page_size
-                               + pos % self.page_size)
-            page_tables[s, :len(seq.pages)] = seq.pages
-            context_lens[s] = seq.num_tokens
+            self._fill_decode_row(seq, s, 0, tokens, positions, slot_mapping,
+                                  page_tables, context_lens)
 
         return ScheduledBatch(
             kind="decode", seqs=scheduled, tokens=tokens, positions=positions,

@@ -40,6 +40,7 @@ from ..ops.attention import (
     prefill_history_attention_tp,
     paged_decode_attention,
     paged_decode_attention_tp,
+    mixed_attention,
 )
 
 Params = dict[str, Any]
@@ -59,6 +60,24 @@ class DecodeMeta(NamedTuple):
     slot_mapping: jax.Array   # [B] int32 flat KV slot for the new token
     page_tables: jax.Array    # [B, pages_per_seq] int32 page ids (pad = scrap)
     context_lens: jax.Array   # [B] int32 valid tokens incl. the new one
+
+
+class MixedMeta(NamedTuple):
+    """Metadata for a mixed step over one padded token axis
+    ``T = Tp_bucket + R_pad``: a prefill chunk (tokens [0:Tp_bucket), one
+    sequence, attending to its pool history) followed by decode rows
+    (tokens [Tp_bucket:T), one per running sequence, against the paged
+    pool). The split point is static per compiled shape:
+    ``Tp_bucket = T - page_tables.shape[0]``."""
+    seg_ids: jax.Array          # [T] int32: 0 on chunk tokens, -1 elsewhere
+    positions: jax.Array        # [T] int32 global positions (RoPE)
+    slot_mapping: jax.Array     # [T] int32 KV write slot (pad -> scrap page)
+    logits_indices: jax.Array   # [R_pad] rows to sample: decode rows then
+                                # the chunk's last token
+    chunk_page_table: jax.Array # [1, hist_width] the chunk seq's pages
+    hist_len: jax.Array         # [] int32 chunk history already in the pool
+    page_tables: jax.Array      # [R_pad, pages_bucket] decode page tables
+    context_lens: jax.Array     # [R_pad] decode valid tokens incl. current
 
 
 # ---------------------------------------------------------------------------
@@ -500,6 +519,41 @@ def forward_prefill_hist(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
     h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn,
                                   tp_axis=tp_axis, ep_axis=ep_axis)
+    new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
+                                         meta.slot_mapping))
+    selected = h[meta.logits_indices]
+    return _norm(cfg, selected, params, "final_norm"), new_kv, h
+
+
+def forward_mixed(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                  meta: MixedMeta, kv: KVCache,
+                  use_pallas=None, use_pallas_hist=None, attn_mesh=None):
+    """Mixed prefill/decode step (stall-free batching): ONE forward over the
+    combined token axis — embedding, QKV/MLP matmuls and norms run once for
+    chunk and decode tokens together, so the weight streaming a decode step
+    pays is amortized over the prefill chunk riding along — with attention
+    split at the static chunk/decode boundary: chunk tokens run history
+    attention against their own pool pages, decode rows run paged decode
+    (ops.attention.mixed_attention). Returns (normed_selected [R_pad, d],
+    new_kv, raw_hidden [T, d]).
+
+    Single-mesh and GSPMD-tp regimes only — under pp the layer stack is
+    sharded outside this path and under sp ring attention replaces the
+    ragged kernels; the engine falls back to the legacy scheduler policy
+    there."""
+    scale = cfg.head_dim ** -0.5
+    h = _embed(params, cfg, tokens, meta.positions)
+    n_prefill = tokens.shape[0] - meta.page_tables.shape[0]
+
+    def attn_fn(lp, q, k, v, layer_idx):
+        return mixed_attention(
+            q, k, v, meta.seg_ids, meta.positions, kv.k, kv.v,
+            meta.chunk_page_table, meta.hist_len, meta.page_tables,
+            meta.context_lens, scale, n_prefill=n_prefill, layer=layer_idx,
+            use_pallas=use_pallas, use_pallas_hist=use_pallas_hist,
+            attn_mesh=attn_mesh)
+
+    h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn)
     new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
                                          meta.slot_mapping))
     selected = h[meta.logits_indices]

@@ -71,6 +71,12 @@ class Observability:
         # wall seconds accumulated per decode program mode by the step loop.
         self.decode_mode_tokens = {"greedy": 0, "sampled": 0}
         self.decode_mode_wall_s = {"greedy": 0.0, "sampled": 0.0}
+        # Mixed (stall-free) batching: device steps by kind plus the
+        # cumulative prefill/decode token split of mixed steps — feeds the
+        # kgct_mixed_step_ratio gauge and the bench mixed readout.
+        self.step_kind_counts = {"prefill": 0, "decode": 0, "mixed": 0}
+        self.mixed_prefill_tokens = 0
+        self.mixed_decode_tokens = 0
 
     # -- request lifecycle hooks (engine + scheduler) ------------------------
 
@@ -131,17 +137,39 @@ class Observability:
     # -- step accounting (engine.step) ---------------------------------------
 
     def on_step(self, step: int, kind: str, batch: int, duration_s: float,
-                new_tokens: int, mode: str = None) -> None:
+                new_tokens: int, mode: str = None, prefill_tokens: int = 0,
+                decode_tokens: int = 0) -> None:
         self.step_duration.observe(duration_s)
         self.batch_size.observe(batch)
         self.phases.end_step(step=step, kind=kind, batch=batch,
                              duration_s=duration_s)
+        if kind in self.step_kind_counts:
+            self.step_kind_counts[kind] += 1
         if kind == "decode":
             self.tracer.emit("decode", "", batch=batch, tokens=new_tokens,
                              mode=mode or "greedy")
             if mode in self.decode_mode_tokens:
                 self.decode_mode_tokens[mode] += new_tokens
                 self.decode_mode_wall_s[mode] += duration_s
+        elif kind == "mixed":
+            # The stall-free batching signal: how this step's token budget
+            # split between the prefill chunk and the decode rows.
+            self.mixed_prefill_tokens += prefill_tokens
+            self.mixed_decode_tokens += decode_tokens
+            self.tracer.emit("mixed", "", batch=batch,
+                             prefill_tokens=prefill_tokens,
+                             decode_tokens=decode_tokens)
+
+    def mixed_step_ratio(self):
+        """Fraction of device steps that were mixed prefill/decode steps, or
+        None before any step ran. Near-zero under mixing-off or idle-prefill
+        regimes; rises with sustained load when stall-free batching is
+        doing its job (every prefill that would have stalled decode rode a
+        mixed step instead)."""
+        total = sum(self.step_kind_counts.values())
+        if total <= 0:
+            return None
+        return self.step_kind_counts["mixed"] / total
 
     def sampled_decode_ratio(self):
         """sampled/greedy decode tok/s ratio, or None until both modes have
@@ -178,6 +206,14 @@ class Observability:
                 % (p, fmt(round(self.phases.totals.get(p, 0.0), 6))))
         lines.extend(render_gauge("kgct_sampled_decode_ratio",
                                   self.sampled_decode_ratio()))
+        lines.extend(render_gauge("kgct_mixed_step_ratio",
+                                  self.mixed_step_ratio()))
+        lines.append("# TYPE kgct_mixed_prefill_tokens_total counter")
+        lines.append("kgct_mixed_prefill_tokens_total %d"
+                     % self.mixed_prefill_tokens)
+        lines.append("# TYPE kgct_mixed_decode_tokens_total counter")
+        lines.append("kgct_mixed_decode_tokens_total %d"
+                     % self.mixed_decode_tokens)
         return lines
 
     def export_perfetto(self) -> dict:

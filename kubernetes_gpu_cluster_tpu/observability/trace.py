@@ -21,9 +21,11 @@ import time
 from collections import deque
 from typing import Optional
 
-# Typed event kinds (the request lifecycle, in rough order).
+# Typed event kinds (the request lifecycle, in rough order). "decode" and
+# "mixed" are engine-wide per-step events (empty request id); a "mixed"
+# event carries the step's prefill/decode token split.
 EVENT_KINDS = ("arrival", "queued", "scheduled", "prefill_chunk",
-               "first_token", "decode", "preempt", "resume",
+               "first_token", "decode", "mixed", "preempt", "resume",
                "finish", "abort")
 
 # Events that OPEN / CLOSE a request's async span in the Perfetto export.

@@ -326,6 +326,66 @@ def paged_decode_attention(q, k_cache_l, v_cache_l, page_tables, context_lens,
 
 
 # ---------------------------------------------------------------------------
+# Mixed prefill/decode attention (stall-free batching)
+# ---------------------------------------------------------------------------
+
+def mixed_attention(q, k, v, seg_ids, positions, k_pool, v_pool,
+                    chunk_page_table, hist_len, page_tables, context_lens,
+                    scale, *, n_prefill, layer=None, use_pallas=None,
+                    use_pallas_hist=None, attn_mesh=None):
+    """Attention for one MIXED step: the token axis is
+    ``[prefill chunk | decode rows]`` with a STATIC split at ``n_prefill``
+    (derived from padded bucket shapes, so it resolves at trace time and the
+    compile count stays bounded by the bucket grid).
+
+    - tokens [0:n_prefill): one sequence's prompt chunk — causal within the
+      chunk plus full attention to its committed pool history
+      (``prefill_history_attention``; Pallas flash-history kernel on TPU).
+    - tokens [n_prefill:): one decode token per running sequence against the
+      paged pool (``paged_decode_attention``; Pallas paged-decode kernel on
+      TPU).
+
+    Both halves read the pool PRE-write (this step's K/V fold in directly:
+    the chunk's in-batch, each decode row's as k_cur/v_cur) and the caller
+    commits all new K/V in the one post-scan scatter — the same contract as
+    the pure paths, so no new kernel is needed: prefill segments route
+    through the flash-prefill-history kernel and decode rows through paged
+    decode within one dispatched step. Chunk and decode sequences are
+    disjoint and each half only addresses its own page tables, so no
+    cross-attention between the halves is possible by construction.
+
+    ``attn_mesh``: under a GSPMD tp mesh both halves run per-shard through
+    the existing shard_map wrappers. ``use_pallas_hist`` gates the history
+    kernel independently (mirrors LLMEngine.use_pallas_hist).
+    """
+    qp, kp, vp = q[:n_prefill], k[:n_prefill], v[:n_prefill]
+    qd, kd, vd = q[n_prefill:], k[n_prefill:], v[n_prefill:]
+    segp, posp = seg_ids[:n_prefill], positions[:n_prefill]
+    # The two halves gate their kernels INDEPENDENTLY, mirroring the pure
+    # paths: a hist-only Mosaic probe failure (use_pallas_hist False while
+    # use_pallas stays True) must route the chunk half through plain XLA —
+    # GSPMD-partitionable under a tp mesh — while decode keeps its kernel.
+    if attn_mesh is not None and use_pallas_hist:
+        out_p = prefill_history_attention_tp(
+            attn_mesh, qp, kp, vp, segp, posp, k_pool, v_pool,
+            chunk_page_table[0], hist_len, scale, layer=layer)
+    else:
+        out_p = prefill_history_attention(
+            qp, kp, vp, segp, posp, k_pool, v_pool, chunk_page_table[0],
+            hist_len, scale, layer=layer,
+            use_pallas=use_pallas_hist if attn_mesh is None else False)
+    if attn_mesh is not None:
+        out_d = paged_decode_attention_tp(
+            attn_mesh, qd, k_pool, v_pool, page_tables, context_lens,
+            kd, vd, scale, layer=layer)
+    else:
+        out_d = paged_decode_attention(
+            qd, k_pool, v_pool, page_tables, context_lens, kd, vd, scale,
+            layer=layer, use_pallas=use_pallas)
+    return jnp.concatenate([out_p, out_d], axis=0)
+
+
+# ---------------------------------------------------------------------------
 # Tensor-parallel wrappers: Pallas kernels under a GSPMD mesh via shard_map
 # ---------------------------------------------------------------------------
 #

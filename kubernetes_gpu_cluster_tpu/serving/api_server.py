@@ -711,6 +711,16 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--enable-prefix-caching", action="store_true",
                    help="reuse KV pages across requests sharing a "
                    "page-aligned prompt prefix (vLLM parity)")
+    p.add_argument("--enable-mixed-batch", action="store_true",
+                   help="stall-free mixed prefill/decode batching "
+                   "(Sarathi-style): each device step carries all running "
+                   "decode tokens plus a budgeted chunk of the queue-head "
+                   "prompt, so prefills stop stalling decode and decode "
+                   "stops starving prefill")
+    p.add_argument("--decode-priority-token-budget", type=int, default=None,
+                   help="per-mixed-step token budget; decode rows claim "
+                   "theirs first, the prefill chunk fills the remainder "
+                   "(default: max_prefill_tokens)")
     p.add_argument("--enforce-eager", action="store_true",
                    help="disable jit compile caching (debug; always slower)")
     p.add_argument("--trust-remote-code", action="store_true",
@@ -752,7 +762,9 @@ def main(argv: Optional[list[str]] = None) -> None:
         cache=CacheConfig(hbm_utilization=args.hbm_utilization),
         scheduler=SchedulerConfig(
             max_num_seqs=args.max_num_seqs,
-            enable_prefix_caching=args.enable_prefix_caching),
+            enable_prefix_caching=args.enable_prefix_caching,
+            mixed_batch_enabled=args.enable_mixed_batch,
+            decode_priority_token_budget=args.decode_priority_token_budget),
         parallel=ParallelConfig(tp=args.tensor_parallel_size,
                                 pp=args.pipeline_parallel_size,
                                 sp=args.sequence_parallel_size,

@@ -1,0 +1,93 @@
+"""Compile-count guard: bucketed shapes keep the XLA jit cache bounded.
+
+The engine's whole recompilation-storm defense is shape bucketing: every
+step program compiles once per (kind, padded bucket) and is reused for the
+serving lifetime. The mixed prefill/decode path adds a new shape family —
+(prefill bucket, sampled-row bucket, history-table width) — so this guard
+simulates a mixed load (staggered arrivals, varied prompt lengths, chunked
+long prompts, mixing on) and asserts:
+
+1. the total number of compiled step-program variants stays under a fixed
+   bound derived from the bucket grid (a per-context-length or per-batch
+   recompile would blow through it immediately), and
+2. a second identical load wave compiles NOTHING new — steady state means
+   zero compiles, which is the property sustained serving depends on.
+
+Tier-1 (not slow): a shape-bucket regression must fail fast.
+"""
+
+import numpy as np
+
+from kubernetes_gpu_cluster_tpu.config import (CacheConfig, EngineConfig,
+                                               SchedulerConfig,
+                                               get_model_config)
+from kubernetes_gpu_cluster_tpu.engine import LLMEngine, SamplingParams
+
+PREFILL_BUCKETS = (16, 32)
+DECODE_BUCKETS = (1, 2, 4)
+
+
+def _engine():
+    cfg = EngineConfig(
+        model=get_model_config("debug-tiny"),
+        cache=CacheConfig(page_size=8, num_pages=129),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_prefill_tokens=32,
+            decode_buckets=DECODE_BUCKETS, prefill_buckets=PREFILL_BUCKETS,
+            decode_window=2, mixed_batch_enabled=True))
+    return LLMEngine(cfg)
+
+
+def _compiled_variants(eng) -> int:
+    """Total jit-cache entries across every step program — the number of
+    distinct XLA compilations the load has triggered."""
+    total = 0
+    for fn in (eng._prefill_fn, eng._prefill_hist_fn, eng._mixed_fn,
+               eng._decode_fn, eng._decode_fn_greedy):
+        if fn is not None and hasattr(fn, "_cache_size"):
+            total += fn._cache_size()
+    return total
+
+
+def _run_wave(eng, tag: str) -> None:
+    """Staggered mixed load: varied prompt lengths (sub-bucket, bucket-edge,
+    chunked-long), arrivals interleaved with steps so prefills land while
+    decodes run (the mixed path) and also while idle (the pure path)."""
+    rng = np.random.default_rng(0)
+    lengths = [5, 16, 33, 60, 90, 12]
+    params = SamplingParams(max_tokens=4, temperature=0.0)
+    pending = [(f"{tag}-{i}", rng.integers(1, 500, n).tolist())
+               for i, n in enumerate(lengths)]
+    while pending or eng.has_unfinished_requests():
+        if pending:
+            rid, prompt = pending.pop(0)
+            eng.add_request(rid, prompt, params)
+        for _ in range(2):
+            if eng.has_unfinished_requests():
+                eng.step()
+    while eng.has_unfinished_requests():
+        eng.step()
+
+
+def test_mixed_load_compile_count_bounded():
+    eng = _engine()
+    _run_wave(eng, "w1")
+    first = _compiled_variants(eng)
+    assert eng.obs.step_kind_counts["mixed"] > 0, \
+        "simulation never exercised the mixed path"
+    # Bound from the bucket grid: prefill (Tp x rows), mixed (Tp x rows x
+    # history widths — pages for <=90-token prompts at ps=8 span 3 pow-2
+    # widths), solo-chunk (Tp x widths), decode (batch buckets x 2 modes).
+    n_tp, n_rows = len(PREFILL_BUCKETS), len(DECODE_BUCKETS)
+    bound = (n_tp * n_rows          # pure prefill
+             + n_tp * n_rows * 3    # mixed
+             + n_tp * 3             # solo chunk
+             + n_rows * 2)          # decode greedy/sampled
+    assert 0 < first <= bound, (first, bound)
+
+    # Steady state: an identical second wave must reuse every compiled
+    # variant — one new shape here means some step input scales with
+    # context/batch instead of a bucket.
+    _run_wave(eng, "w2")
+    assert _compiled_variants(eng) == first, \
+        "second identical load wave triggered new XLA compilations"

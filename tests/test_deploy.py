@@ -106,6 +106,26 @@ def test_engine_deployment_shape():
     assert {"/models/Qwen2.5-7B", "/dev/shm"} <= mount_paths
 
 
+def test_mixed_batch_knobs_map_to_engine_flags():
+    """vllmConfig.enableMixedBatch / decodePriorityTokenBudget render to the
+    API server's --enable-mixed-batch / --decode-priority-token-budget (the
+    stall-free TTFT scheduler's deployment surface)."""
+    values = copy.deepcopy(VALUES)
+    cfg = values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"]
+    cfg["enableMixedBatch"] = True
+    cfg["decodePriorityTokenBudget"] = 1536
+    ms = render_values(values)
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--enable-mixed-batch" in args
+    assert args[args.index("--decode-priority-token-budget") + 1] == "1536"
+    # and absent when the values file does not opt in
+    ms = render_values(copy.deepcopy(VALUES))
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--enable-mixed-batch" not in args
+
+
 def test_engine_pod_graceful_drain_contract():
     """The deploy renderer must give the SIGTERM drain room to work: a
     preStop sleep so endpoint removal outruns the signal, and a termination

@@ -1,0 +1,357 @@
+"""Mixed prefill/decode batching (stall-free TTFT scheduler).
+
+The bar for the mixed path is the same as chunked prefill's: IDENTICAL
+output to the legacy prefill-else-decode policy (greedy, and seeded
+sampled — per-request seeds derive from (seed, position) so they reproduce
+across engines), with decode never stalled behind a prefill window. Plus
+the policy/layout contracts: decode rows claim the token budget first, the
+unified ragged layout addresses both halves correctly, and the legacy
+invariants (mid-chunk sequence only at waiting[0]; preemption never admits
+waiting work) survive the mixing path.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_gpu_cluster_tpu.config import (CacheConfig, EngineConfig,
+                                               SchedulerConfig,
+                                               get_model_config)
+from kubernetes_gpu_cluster_tpu.engine import LLMEngine, SamplingParams
+from kubernetes_gpu_cluster_tpu.engine.mixed_batch import (build_mixed_batch,
+                                                           plan_chunk_tokens)
+from kubernetes_gpu_cluster_tpu.engine.scheduler import Scheduler
+from kubernetes_gpu_cluster_tpu.engine.sequence import (Sequence,
+                                                        SequenceStatus)
+
+
+def _cfg(mixed=True, num_pages=65, page_size=4, max_num_seqs=4,
+         max_prefill_tokens=16, budget=None, decode_window=2):
+    return EngineConfig(
+        model=get_model_config("debug-tiny"),
+        cache=CacheConfig(page_size=page_size, num_pages=num_pages),
+        scheduler=SchedulerConfig(
+            max_num_seqs=max_num_seqs, max_prefill_tokens=max_prefill_tokens,
+            decode_buckets=(1, 2, 4), prefill_buckets=(16, 32, 64),
+            decode_window=decode_window, mixed_batch_enabled=mixed,
+            decode_priority_token_budget=budget))
+
+
+def _seq(rid, n_prompt, max_tokens=64):
+    return Sequence(rid, list(range(1, n_prompt + 1)),
+                    SamplingParams(max_tokens=max_tokens))
+
+
+class TestPolicy:
+    def test_decode_rows_claim_budget_first(self):
+        # budget 16, 3 decode rows -> at most 13 chunk tokens
+        assert plan_chunk_tokens(100, 3, None, 16) == 13
+        assert plan_chunk_tokens(5, 3, None, 16) == 5      # remaining caps
+        assert plan_chunk_tokens(100, 16, None, 16) == 0   # no room left
+        # explicit mixed budget larger than the prefill budget: the chunk is
+        # still capped by max_prefill_tokens
+        assert plan_chunk_tokens(100, 4, 64, 16) == 16
+        # explicit smaller budget wins
+        assert plan_chunk_tokens(100, 1, 8, 16) == 7
+
+    def test_mixed_only_when_decode_and_prefill_coexist(self):
+        sched = Scheduler(_cfg(), 65)
+        sched.add(_seq("a", 8))
+        assert sched.schedule().kind == "prefill"   # nothing running yet
+        sched.waiting.append(_seq("b", 40))
+        # nothing appended to "a" yet — it still decodes from its prompt
+        batch = sched.schedule()
+        assert batch.kind == "mixed"
+
+    def test_disabled_keeps_legacy_kinds(self):
+        sched = Scheduler(_cfg(mixed=False), 65)
+        sched.add(_seq("a", 8))
+        assert sched.schedule().kind == "prefill"
+        sched.add(_seq("b", 40))
+        kinds = {sched.schedule().kind for _ in range(6)}
+        assert "mixed" not in kinds
+
+    def test_burst_of_packable_prompts_keeps_legacy_packed_prefill(self):
+        """Two+ whole fresh prompts that fit one legacy prefill batch must
+        NOT be serialized through head-only mixed steps — one packed step
+        admits them all (burst stability); mixing engages once the queue is
+        down to a single prompt."""
+        sched = Scheduler(_cfg(max_num_seqs=8), 65)
+        a = _seq("a", 8)
+        sched.add(a)
+        assert sched.schedule().kind == "prefill"
+        a.append_token(9)
+        sched.add(_seq("p1", 6))
+        sched.add(_seq("p2", 6))
+        sched.add(_seq("p3", 6))
+        batch = sched.schedule()
+        assert batch.kind == "prefill"         # packed, not mixed
+        # budget 16 fits two 6-token prompts per packed step
+        assert {s.request_id for s in batch.seqs} == {"p1", "p2"}
+        # one fresh prompt left waiting -> stall-free mixing engages
+        assert sched.schedule().kind == "mixed"
+
+    def test_chunk_streaming_head_mixes_even_under_burst(self):
+        """An oversized head streams through mixed chunks regardless of
+        queue depth — long prompts are where prefill stalls hurt most."""
+        sched = Scheduler(_cfg(), 65)
+        a = _seq("a", 8)
+        sched.add(a)
+        sched.schedule()
+        a.append_token(9)
+        sched.add(_seq("long", 40))            # > 16-token budget: chunks
+        sched.add(_seq("p1", 6))
+        sched.add(_seq("p2", 6))
+        assert sched.schedule().kind == "mixed"
+
+    def test_full_occupancy_partial_chunk_stays_in_bucket_grid(self):
+        """With every max_num_seqs seat running, D+1 sampled rows would
+        escape the decode-bucket grid (next_power_of_2 fallback = an
+        unwarmed compile shape mid-serving). Mixing must bow out — even for
+        a PARTIAL chunk, which needs no seat — and leave the step to the
+        legacy policy."""
+        sched = Scheduler(_cfg(max_num_seqs=4), 65)   # buckets (1,2,4)
+        seqs = [_seq(f"r{i}", 4) for i in range(4)]
+        for s in seqs:
+            sched.add(s)
+        assert sched.schedule().kind == "prefill"
+        for s in seqs:
+            s.append_token(9)
+        sched.add(_seq("long", 40))                   # chunkable head
+        batch = sched.schedule()
+        assert batch.kind != "mixed"
+
+    def test_budget_full_of_decodes_falls_back_to_pure_decode(self):
+        cfg = _cfg(budget=1)   # 1 decode row already exhausts the budget
+        sched = Scheduler(cfg, 65)
+        sched.add(_seq("a", 8))
+        sched.schedule()
+        sched.add(_seq("b", 12))
+        batch = sched.schedule()
+        # mixing had no room for a chunk; the head won a pure prefill batch
+        # (legacy policy) rather than being starved forever
+        assert batch.kind == "prefill"
+
+
+class TestConfigValidation:
+    def test_engine_rejects_unusable_mixed_budget(self):
+        """A decode-priority budget that can never fit a decode row plus a
+        chunk token must fail loudly at engine init, not leave mixing
+        silently inert (kgct_mixed_step_ratio reading 0 forever)."""
+        with pytest.raises(ValueError, match="decode_priority_token_budget"):
+            LLMEngine(_cfg(budget=1))
+
+
+class TestLayout:
+    def _mixed_state(self):
+        sched = Scheduler(_cfg(), 65)
+        a = _seq("a", 8)
+        sched.add(a)
+        assert sched.schedule().kind == "prefill"
+        a.append_token(9)                      # one decode output committed
+        long = _seq("long", 40)
+        sched.add(long)
+        return sched, a, long
+
+    def test_unified_ragged_layout(self):
+        sched, a, long = self._mixed_state()
+        batch = sched.schedule()
+        assert batch.kind == "mixed"
+        assert batch.seqs == [a, long]         # decode rows, then the chunk
+        # budget 16 - 1 decode row = 15 chunk tokens
+        assert batch.prefill_token_count == 15
+        assert batch.partial and batch.hist_len == 0
+        assert long.num_prefilled == 15
+        Tp = 16                                # _bucket(15, prefill_buckets)
+        assert batch.tokens.shape == (Tp + 2,)  # R_pad = _bucket(2, decode)
+        np.testing.assert_array_equal(batch.tokens[:15],
+                                      long.prompt_token_ids[:15])
+        np.testing.assert_array_equal(batch.seg_ids[:15], 0)
+        assert batch.seg_ids[15] == -1 and set(batch.seg_ids[Tp:]) == {-1}
+        np.testing.assert_array_equal(batch.positions[:15], np.arange(15))
+        # decode row: a's last output token at position num_tokens-1
+        assert batch.tokens[Tp] == 9
+        assert batch.positions[Tp] == a.num_tokens - 1
+        assert batch.context_lens[0] == a.num_tokens
+        np.testing.assert_array_equal(batch.page_tables[0, :len(a.pages)],
+                                      a.pages)
+        np.testing.assert_array_equal(
+            batch.chunk_page_table[0, :len(long.pages)], long.pages)
+        # sampled rows: decode row first, the chunk's last token second
+        np.testing.assert_array_equal(batch.logits_indices, [Tp, 14])
+        # KV write slots: chunk tokens into long's pages, decode row into a's
+        ps = sched.page_size
+        pos = a.num_tokens - 1
+        assert batch.slot_mapping[Tp] == (a.pages[pos // ps] * ps + pos % ps)
+        np.testing.assert_array_equal(
+            batch.slot_mapping[:15],
+            [long.pages[p // ps] * ps + p % ps for p in range(15)])
+
+    def test_chunk_streams_to_final_and_joins_running(self):
+        sched, a, long = self._mixed_state()
+        hist = []
+        while long.status != SequenceStatus.RUNNING:
+            batch = sched.schedule()
+            assert batch.kind == "mixed"
+            hist.append((batch.hist_len, long.num_prefilled, batch.partial))
+        # 40 tokens at 15/step: [0:15) [15:30) [30:40) — final joins running
+        assert hist == [(0, 15, True), (15, 30, True), (30, 40, False)]
+        assert long in sched.running and long not in sched.waiting
+        assert sched.schedule().kind == "decode"   # queue drained
+
+
+class TestInvariants:
+    def test_preempt_victim_slots_behind_mid_chunk_head(self):
+        """The legacy invariant — a mid-chunk sequence (holding pages) is
+        only ever at waiting[0] — must survive preemption triggered from
+        the MIXED path's decode page growth: the victim slots in BEHIND the
+        mid-chunk head, never displacing it."""
+        cfg = _cfg(num_pages=13, page_size=4, max_num_seqs=4,
+                   max_prefill_tokens=16)      # 12 usable pages
+        sched = Scheduler(cfg, 13)
+        a, b = _seq("a", 8), _seq("b", 8)      # 2 pages each
+        sched.add(a)
+        sched.add(b)
+        assert sched.schedule().kind == "prefill"
+        a.append_token(9)
+        b.append_token(9)
+        long = _seq("long", 40)                # will chunk across many steps
+        sched.add(long)
+        batch = sched.schedule()               # mixed: chunk takes pages
+        assert batch.kind == "mixed" and batch.partial
+        assert sched.waiting[0] is long and long.num_prefilled > 0
+        assert long.pages                      # mid-chunk head holding pages
+        # Exhaust the pool so the next decode growth must preempt: grow a/b
+        # to their page boundaries and drain free pages.
+        free = sched.allocator.num_free
+        if free:
+            hold = sched.allocator.allocate(free)
+        for s in (a, b):
+            while s.num_tokens % 4 != 0:       # fill the current page
+                s.append_token(7)
+            s.append_token(7)                  # first token of a NEW page
+        batch = sched.schedule()
+        # b (youngest running) was preempted; the mid-chunk head kept
+        # waiting[0] and the victim slotted in at waiting[1].
+        assert sched.num_preemptions >= 1
+        assert sched.waiting[0] is long
+        assert sched.waiting[1] is b
+        assert b.status == SequenceStatus.PREEMPTED and not b.pages
+
+    def test_abort_mid_chunk_head_releases_pages(self):
+        """Aborting the mid-chunk head (pages held, prompt incomplete)
+        under the mixed path frees its pages and unblocks the queue."""
+        eng = LLMEngine(_cfg())
+        eng.add_request("a", list(range(1, 9)),
+                        SamplingParams(max_tokens=8, temperature=0.0))
+        eng.step()                             # prefill a
+        free0 = eng.scheduler.allocator.num_free
+        eng.add_request("long", list(range(1, 61)),
+                        SamplingParams(max_tokens=8, temperature=0.0))
+        eng.step()                             # mixed: chunk holds pages
+        head = eng.scheduler.waiting[0]
+        assert head.request_id == "long" and head.num_prefilled > 0
+        free_mid = eng.scheduler.allocator.num_free
+        held = len(head.pages)
+        assert held > 0 and free_mid < free0
+        assert eng.abort_request("long")
+        # exactly the chunk's pages come back (the survivor's legitimate
+        # decode page growth stays)
+        assert eng.scheduler.allocator.num_free == free_mid + held
+        assert all(s.request_id != "long" for s in eng.scheduler.waiting)
+        # engine still serves the survivor to completion
+        while eng.has_unfinished_requests():
+            outs = eng.step()
+        assert not eng.scheduler.has_work()
+
+    def test_mixed_never_preempts_to_admit_prefill(self):
+        """Chunk page allocation must never evict running decodes: with no
+        free pages for the chunk, mixing bows out and decode proceeds."""
+        cfg = _cfg(num_pages=5, page_size=4, max_num_seqs=4)  # 4 usable
+        sched = Scheduler(cfg, 5)
+        a = _seq("a", 7, max_tokens=1)         # 2 pages (8 slots)
+        b = _seq("b", 7, max_tokens=1)         # 2 pages -> pool full
+        sched.add(a)
+        sched.add(b)
+        assert sched.schedule().kind == "prefill"
+        a.append_token(9)                      # slot 7: no page growth needed
+        b.append_token(9)
+        sched.add(_seq("waiting", 8))
+        batch = sched.schedule()
+        assert batch.kind == "decode"          # no pages for a chunk
+        assert sched.num_preemptions == 0
+        assert len(batch.seqs) == 2
+
+
+class TestEngineParity:
+    @staticmethod
+    def _workload(eng, tag, temperature=0.0, seed=None):
+        rng = np.random.default_rng(0)
+        prompts = {"a": rng.integers(1, 500, 20).tolist(),
+                   "long": rng.integers(1, 500, 70).tolist(),
+                   "b": rng.integers(1, 500, 12).tolist()}
+        params = SamplingParams(max_tokens=8, temperature=temperature,
+                                top_k=40 if temperature else 0, seed=seed)
+        outs, kinds = {}, []
+        eng.add_request(f"{tag}-a", prompts["a"], params)
+        for _ in range(2):                      # a prefills, starts decoding
+            for o in eng.step():
+                if o.finished:
+                    outs[o.request_id] = o.output_token_ids
+        eng.add_request(f"{tag}-long", prompts["long"], params)
+        eng.add_request(f"{tag}-b", prompts["b"], params)
+        while eng.has_unfinished_requests():
+            for o in eng.step():
+                if o.finished:
+                    outs[o.request_id] = o.output_token_ids
+            if eng._last_step_info:
+                kinds.append(eng._last_step_info[0])
+        return {k.split("-", 1)[1]: v for k, v in outs.items()}, kinds
+
+    def test_outputs_identical_to_legacy(self):
+        """Greedy AND seeded-sampled outputs must be byte-identical to the
+        legacy policy (per-request seeds derive from (seed, position), so
+        they reproduce across engines). One engine pair serves both
+        workloads — mid-decode arrivals exercise the mixed path, whose
+        steps the legacy engine must never take."""
+        legacy = LLMEngine(_cfg(mixed=False, max_prefill_tokens=32))
+        mixed = LLMEngine(_cfg(mixed=True, max_prefill_tokens=32))
+        ref, kinds_off = self._workload(legacy, "g")
+        got, kinds_on = self._workload(mixed, "g")
+        assert "mixed" in kinds_on and "mixed" not in kinds_off
+        assert got == ref
+        # the long prompt streamed through mixed steps instead of stalling
+        # the running decodes behind pure prefill windows
+        assert mixed.obs.mixed_prefill_tokens > 0
+        assert mixed.obs.mixed_decode_tokens > 0
+        # seeded sampled workload on the same engines
+        ref, _ = self._workload(legacy, "s", temperature=1.0, seed=7)
+        got, kinds = self._workload(mixed, "s", temperature=1.0, seed=7)
+        assert "mixed" in kinds
+        assert got == ref
+        # observability rode along: ratio gauge, token counters, and
+        # per-step trace events with the prefill/decode split
+        ratio = mixed.obs.mixed_step_ratio()
+        assert ratio is not None and 0.0 < ratio < 1.0
+        assert mixed.obs.step_kind_counts["mixed"] >= 1
+        assert legacy.obs.mixed_step_ratio() == 0.0
+        text = "\n".join(mixed.obs.render_prometheus())
+        assert "kgct_mixed_step_ratio" in text
+        assert "kgct_mixed_prefill_tokens_total" in text
+        assert "kgct_mixed_decode_tokens_total" in text
+        mixed_events = [e for e in mixed.obs.tracer.events()
+                        if e.kind == "mixed"]
+        assert mixed_events
+        assert all(e.args["prefill_tokens"] > 0
+                   and e.args["decode_tokens"] > 0 for e in mixed_events)
+
+
+class TestObservability:
+    def test_fresh_engine_ratio_is_none_and_renders_clean(self):
+        from kubernetes_gpu_cluster_tpu.observability import Observability
+        obs = Observability(enabled=True)
+        assert obs.mixed_step_ratio() is None
+        text = "\n".join(obs.render_prometheus())
+        assert "nan" not in text.lower()
+        # gauge absent (None renders nothing); counters present at 0
+        assert "kgct_mixed_step_ratio " not in text
+        assert "kgct_mixed_prefill_tokens_total 0" in text
