@@ -93,6 +93,15 @@ OVERLOAD_TTFT_BUDGET_MS = float(
 # measurement and mixing is the scheduler-level fix it exists to validate;
 # KGCT_BENCH_MIXED=0 runs the legacy prefill-else-decode policy (A/B).
 MIXED_BATCH = os.environ.get("KGCT_BENCH_MIXED", "1") != "0"
+# Speculative decoding phase (engine/spec/): greedy decode over a
+# repetitive-suffix workload (the n-gram proposer's home turf), spec-on vs
+# spec-off on identically-seeded engines, reporting acceptance ratio and
+# accepted tokens per spec step. KGCT_BENCH_SPEC=0 skips the phase;
+# KGCT_BENCH_SPEC_K sets the draft length.
+SPEC_BENCH = os.environ.get("KGCT_BENCH_SPEC", "1") != "0"
+SPEC_K = int(os.environ.get("KGCT_BENCH_SPEC_K", 4))
+SPEC_BATCH = int(os.environ.get("KGCT_BENCH_SPEC_BATCH", 4))
+SPEC_MAX_NEW = int(os.environ.get("KGCT_BENCH_SPEC_MAX_NEW", 96))
 
 # The stdout contract bench.py guarantees (also the --help epilog, and what
 # tests/test_bench_contract.py pins): everything before the last line is
@@ -473,6 +482,81 @@ def _measure_overload(engine, rng, vocab, rate_rps, budget_ms):
     }
 
 
+def _measure_spec(model_name: str, quant, rng) -> dict:
+    """Speculative-decoding phase: greedy decode over a repetitive-suffix
+    workload (prompts built from a short repeated pattern, so prompt-lookup
+    drafts hit), spec-on vs spec-off engines with IDENTICAL weights (same
+    config seed). Reports the acceptance ratio, accepted draft tokens per
+    spec step (the >1.0 bar that makes a verify step beat a plain decode
+    step in tokens), and the decode tokens/sec of both engines. Runs after
+    the main config's engine is freed — on-chip, two more model
+    instantiations must not overlap the big serving pool."""
+    on_tpu = jax.default_backend() == "tpu"
+    page = PAGE if PAGE is not None else (128 if on_tpu else 16)
+    pattern = rng.integers(1, 200, 12).tolist()
+    reps = cdiv(PROMPT_LEN, len(pattern))
+    prompts = [(pattern * reps)[:PROMPT_LEN] for _ in range(SPEC_BATCH)]
+    params = SamplingParams(max_tokens=SPEC_MAX_NEW, temperature=0.0)
+    out = {"k": SPEC_K, "batch": SPEC_BATCH, "max_new": SPEC_MAX_NEW}
+
+    for label, spec in (("base", False), ("spec", True)):
+        pages_per_seq = cdiv(PROMPT_LEN + SPEC_MAX_NEW + SPEC_K, page) + 2
+        cfg = EngineConfig(
+            model=get_model_config(model_name).replace(quantization=quant),
+            cache=CacheConfig(page_size=page,
+                              num_pages=SPEC_BATCH * pages_per_seq + 1),
+            scheduler=SchedulerConfig(
+                max_num_seqs=SPEC_BATCH, max_prefill_tokens=PREFILL_BUDGET,
+                decode_buckets=(SPEC_BATCH,), prefill_buckets=(PREFILL_BUDGET,),
+                decode_window=DECODE_WINDOW, mixed_batch_enabled=False,
+                spec_decode_enabled=spec, num_speculative_tokens=SPEC_K))
+        engine = LLMEngine(cfg, eos_token_id=None)
+        # Warmup pass compiles every program this workload touches (the
+        # measurement discipline: never time XLA compilation).
+        for i, p in enumerate(prompts):
+            engine.add_request(f"warm-{i}", list(p), params)
+        while engine.has_unfinished_requests():
+            engine.step()
+        for i, p in enumerate(prompts):
+            engine.add_request(f"m-{i}", list(p), params)
+        while engine.scheduler.waiting:
+            engine.step()
+        steps0 = engine.stats.steps
+        drafted0 = engine.obs.spec_drafted_tokens
+        accepted0 = engine.obs.spec_accepted_tokens
+        spec_steps0 = engine.obs.step_kind_counts["spec"]
+        new_tokens = 0
+        t0 = time.perf_counter()
+        while engine.has_unfinished_requests():
+            new_tokens += sum(len(o.new_token_ids or [])
+                              for o in engine.step())
+        wall = time.perf_counter() - t0
+        out[label] = {
+            "decode_tokens_per_sec": round(new_tokens / wall, 1),
+            "decode_steps": engine.stats.steps - steps0,
+        }
+        if spec:
+            drafted = engine.obs.spec_drafted_tokens - drafted0
+            accepted = engine.obs.spec_accepted_tokens - accepted0
+            n_spec = engine.obs.step_kind_counts["spec"] - spec_steps0
+            out["spec"].update({
+                "spec_steps": n_spec,
+                "drafted_tokens": drafted,
+                "accepted_tokens": accepted,
+                "acceptance_ratio": (round(accepted / drafted, 3)
+                                     if drafted else None),
+                "accepted_tokens_per_spec_step": (round(accepted / n_spec, 2)
+                                                  if n_spec else None),
+            })
+        del engine
+        gc.collect()
+    base, spec = out["base"], out["spec"]
+    out["speedup"] = (round(spec["decode_tokens_per_sec"]
+                            / base["decode_tokens_per_sec"], 3)
+                      if base["decode_tokens_per_sec"] else None)
+    return out
+
+
 # --------------------------------------------------------------------------
 # Per-config driver
 # --------------------------------------------------------------------------
@@ -652,6 +736,9 @@ def assemble_output(results: list[dict], backend: str) -> dict:
         "ttft_decomposition": primary.get("ttft_decomposition"),
         "sampled_over_greedy": primary.get("sampled_over_greedy"),
         "mixed_batch": primary.get("mixed_batch"),
+        # Speculative phase headline (full block in configs[-1].speculative).
+        "spec_acceptance_ratio": (primary.get("speculative", {})
+                                  .get("spec", {}).get("acceptance_ratio")),
         "configs": results,
     }
 
@@ -699,7 +786,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
             "KGCT_BENCH_OVERLOAD_UTIL, KGCT_BENCH_OVERLOAD_REQS, "
             "KGCT_BENCH_TTFT_BUDGET_MS, KGCT_BENCH_MIXED (1=stall-free "
             "mixed prefill/decode batching, default on; 0=legacy "
-            "prefill-else-decode), KGCT_BENCH_PROMPT, KGCT_BENCH_PAGE, "
+            "prefill-else-decode), KGCT_BENCH_SPEC (1=speculative-decoding "
+            "phase on a repetitive-suffix workload, default on; 0=skip), "
+            "KGCT_BENCH_SPEC_K, KGCT_BENCH_SPEC_BATCH, "
+            "KGCT_BENCH_SPEC_MAX_NEW, KGCT_BENCH_PROMPT, KGCT_BENCH_PAGE, "
             "KGCT_CHIP_HBM_GBPS, KGCT_CHIP_TFLOPS_BF16."))
     return p
 
@@ -763,6 +853,12 @@ def main() -> None:
 
     host_rt_s = _measure_host_rt_s()
     results = [run_config(host_rt_s=host_rt_s, rng=rng, **c) for c in configs]
+    if SPEC_BENCH:
+        # Speculative phase rides the PRIMARY config's model; it builds its
+        # own (small-batch) engines, after run_config freed the big one.
+        primary = configs[-1]
+        results[-1]["speculative"] = _measure_spec(
+            primary["model_name"], primary.get("quant"), rng)
     emit_result(assemble_output(results, backend))
 
 

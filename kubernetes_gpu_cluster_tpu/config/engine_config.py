@@ -55,15 +55,33 @@ class SchedulerConfig:
     # running decodes and waiting prefill work coexist, one device step
     # carries every running sequence's decode token PLUS a budgeted chunk of
     # the queue-head prompt — prefills no longer stall decode and decode no
-    # longer starves prefill (engine/mixed_batch.py). Off by default: the
-    # legacy prefill-else-decode policy is the behavioral baseline; serving
-    # enables it via --enable-mixed-batch, bench via KGCT_BENCH_MIXED=1.
-    mixed_batch_enabled: bool = False
+    # longer starves prefill (engine/mixed_batch.py). ON by default since the
+    # PR-3 CPU A/B showed sustained p50 TTFT 2408->2117 ms with mixing on;
+    # serving opts out via --disable-mixed-batch, bench via
+    # KGCT_BENCH_MIXED=0 (legacy prefill-else-decode policy).
+    mixed_batch_enabled: bool = True
     # Per-mixed-step token budget. Decode rows claim their tokens FIRST
     # (decode is never dropped from a mixed step); the head prompt's chunk
     # fills the remainder, still capped by max_prefill_tokens. None = use
     # max_prefill_tokens as the mixed budget.
     decode_priority_token_budget: Optional[int] = None
+    # Speculative decoding (engine/spec/): pure-decode steps draft
+    # num_speculative_tokens per running sequence with an n-gram
+    # prompt-lookup proposer (no draft model) and verify all drafts in ONE
+    # dispatched device program; acceptance is exact-match for greedy and
+    # lossless rejection sampling for sampled decode, so outputs keep the
+    # target distribution. Off by default: serving enables it via
+    # --enable-spec-decode, bench via KGCT_BENCH_SPEC.
+    spec_decode_enabled: bool = False
+    # Draft length k per spec step. STATIC: the verify program compiles per
+    # (decode bucket) at token width B_pad * (k + 1), so k is part of the
+    # bounded compile-shape grid, never a runtime dimension.
+    num_speculative_tokens: int = 4
+    # Prompt-lookup window: the proposer matches the sequence's trailing
+    # n-gram (n from max down to min) against its own prompt+output history
+    # and drafts the continuation of the most recent match.
+    spec_ngram_max: int = 3
+    spec_ngram_min: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

@@ -102,12 +102,20 @@ def _engine_args(spec: dict) -> list[str]:
         args += ["--max-model-len", str(cfg["maxModelLen"])]
     if cfg.get("enablePrefixCaching"):
         args += ["--enable-prefix-caching"]
-    if cfg.get("enableMixedBatch"):
-        # Stall-free mixed prefill/decode batching (the TTFT QoS lever).
-        args += ["--enable-mixed-batch"]
-        if cfg.get("decodePriorityTokenBudget") is not None:
-            args += ["--decode-priority-token-budget",
-                     str(cfg["decodePriorityTokenBudget"])]
+    # Stall-free mixed prefill/decode batching (the TTFT QoS lever) is the
+    # ENGINE default now; the values schema opts out with an explicit
+    # ``enableMixedBatch: false`` (``true``/absent both render no flag).
+    if cfg.get("enableMixedBatch") is False:
+        args += ["--disable-mixed-batch"]
+    if cfg.get("decodePriorityTokenBudget") is not None:
+        args += ["--decode-priority-token-budget",
+                 str(cfg["decodePriorityTokenBudget"])]
+    if cfg.get("enableSpecDecode"):
+        # Speculative decoding: n-gram drafting + batched verification.
+        args += ["--enable-spec-decode"]
+        if cfg.get("numSpeculativeTokens") is not None:
+            args += ["--num-speculative-tokens",
+                     str(cfg["numSpeculativeTokens"])]
     # enableChunkedPrefill needs no flag: long prompts always chunk here.
     if os.path.isabs(str(spec["modelURL"])):
         # Local checkpoint dir (hostPath-mounted): weights + tokenizer live

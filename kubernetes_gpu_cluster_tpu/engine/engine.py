@@ -30,10 +30,11 @@ import numpy as np
 from ..config import EngineConfig
 from ..models import llama as model_lib
 from ..observability import Observability
-from ..models.llama import DecodeMeta, MixedMeta, PrefillMeta
+from ..models.llama import DecodeMeta, MixedMeta, PrefillMeta, SpecMeta
 from ..ops.sampling import (apply_logit_bias, apply_penalties, build_counts,
                             bump_counts, gated_top_logprobs, row_sample_keys,
-                            sample_and_logprobs, token_logprobs)
+                            sample_and_logprobs, spec_verify_sample,
+                            token_logprobs)
 from ..resilience.faults import inject as _inject_fault
 from ..utils import cdiv, get_logger
 from .kv_cache import KVCache, allocate_kv_cache, derive_num_pages
@@ -240,6 +241,19 @@ class LLMEngine:
                     "bucket grid (max %d); steps with more running sequences"
                     " than the grid covers keep the legacy policy",
                     sc.max_num_seqs, sc.decode_buckets[-1])
+        # Speculative decoding: pure-decode steps become batched draft
+        # verification (engine/spec/). Single-mesh and GSPMD-tp regimes
+        # only, like the mixed path — under pp the layer stack is sharded
+        # outside forward_spec_verify and under sp ring attention replaces
+        # the paged layout it splits on.
+        if self.scheduler.spec_enabled and (self.pp_size > 1
+                                            or self.sp_size > 1):
+            logger.warning(
+                "spec decode disabled: no spec-verify forward path under "
+                "pp=%d/sp=%d meshes", self.pp_size, self.sp_size)
+            self.scheduler.spec_enabled = False
+        self._spec_verify_fn = (self._build_spec_verify_fn()
+                                if self.scheduler.spec_enabled else None)
         self.stats = EngineStats()
         self.step_count = 0
         # Speculative decode-window chain state (see step()).
@@ -620,6 +634,57 @@ class LLMEngine:
 
         return self._maybe_jit(mixed_step, donate_argnums=(1,))
 
+    def _build_spec_verify_fn(self):
+        """Speculative-verification step (models.forward_spec_verify): ONE
+        program runs every running sequence's [last token, k drafts] slice —
+        history attention against the paged pool, an S x S causal block per
+        row, multi-token KV append — and applies the lossless accept/
+        resample rule over the per-position logits
+        (ops.sampling.spec_verify_sample). Compiled per decode-bucketed row
+        count at token width R_pad * S; S = k + 1 is config-static, so the
+        variant family stays inside the bounded bucket grid
+        (tests/test_compile_guard.py pins it). Penalties use the
+        host-resynced histogram (out_tokens) like the chunked/mixed paths —
+        spec steps are synchronous, so the host always knows the full
+        output history — and the verifier advances the counts with each
+        accepted token, matching the decode window's per-substep bump."""
+        cfg = self.model_config
+        use_pallas = self.use_pallas
+        V = cfg.vocab_size
+
+        def spec_step(params, kv: KVCache, int_t, int_b, float_b,
+                      page_tables, context_lens, out_tokens,
+                      bias_ids, bias_vals, key):
+            # int_t: [4, R_pad*S]; int_b: [R_pad, 3] = (top_k, seed, top_n).
+            R_pad = page_tables.shape[0]
+            S = int_t.shape[1] // R_pad
+            meta = SpecMeta(seg_ids=int_t[1], positions=int_t[2],
+                            slot_mapping=int_t[3], page_tables=page_tables,
+                            context_lens=context_lens)
+            hidden, kv, _ = model_lib.forward_spec_verify(
+                params, cfg, int_t[0], meta, kv, use_pallas=use_pallas)
+            # Verification needs logits over EVERY draft position, so the
+            # vocab projection runs on all R_pad*S rows (the one place the
+            # engine pays more than B logit rows; amortized by acceptance).
+            logits = model_lib.compute_logits(params, cfg, hidden)
+            logits = _maybe_bias(logits, jnp.repeat(bias_ids, S, axis=0),
+                                 jnp.repeat(bias_vals, S, axis=0))
+            logits = logits.reshape(R_pad, S, V)
+            drafts = int_t[0].reshape(R_pad, S)[:, 1:]
+            presence, frequency = float_b[:, 2], float_b[:, 3]
+            counts = jax.lax.cond(
+                jnp.any((presence != 0.0) | (frequency != 0.0)),
+                lambda ot: build_counts(ot, V),
+                lambda ot: jnp.zeros((R_pad, V), jnp.int32), out_tokens)
+            toks, n_acc, lps, tids, tlps = spec_verify_sample(
+                logits, drafts, context_lens, key, int_b[:, 1],
+                float_b[:, 0], int_b[:, 0], float_b[:, 1],
+                presence, frequency, counts,
+                with_top=jnp.any(int_b[:, 2] > 0))
+            return toks, n_acc, lps, tids, tlps, kv
+
+        return self._maybe_jit(spec_step, donate_argnums=(1,))
+
     def _build_decode_fn(self, greedy: bool = False):
         """Multi-step decode: W autoregressive steps inside one XLA program.
         Sampled tokens feed back on-device through a lax.scan; per-sub-step
@@ -846,14 +911,15 @@ class LLMEngine:
         if info is None:
             self.obs.phases.discard_step()
         else:
-            # Mixed steps extend the info tuple with their per-step
-            # prefill/decode token split (the stall-free batching signal).
+            # Mixed/spec steps extend the info tuple with kind-specific
+            # extras (mixed: the prefill/decode token split; spec: the
+            # drafted/accepted token counts).
             kind, bsize, mode = info[:3]
-            pf_tok, dc_tok = (info[3], info[4]) if len(info) > 3 else (0, 0)
+            extra = info[3] if len(info) > 3 else {}
             self.obs.on_step(
                 step=self.step_count, kind=kind, batch=bsize, duration_s=dt,
                 new_tokens=sum(len(o.new_token_ids or []) for o in outs),
-                mode=mode, prefill_tokens=pf_tok, decode_tokens=dc_tok)
+                mode=mode, **extra)
         return outs
 
     def _step(self) -> list[RequestOutput]:
@@ -884,6 +950,8 @@ class LLMEngine:
                      batch.frequency], axis=1))
             if batch.kind == "mixed":
                 return drained + self._step_mixed(batch, float_b, step_key)
+            if batch.kind == "spec":
+                return drained + self._step_spec(batch, float_b, step_key)
             if batch.kind == "prefill":
                 with ph("host_prep"):
                     int_t = jnp.asarray(np.stack(
@@ -950,7 +1018,13 @@ class LLMEngine:
             inflight["drained"] = drained
 
         successor = None
-        if not self.scheduler.waiting and not inflight["zombies"]:
+        # With spec decode enabled, decode windows never speculatively
+        # chain: draft verification IS the speculation mechanism, and a
+        # chained successor would pin the engine in legacy decode even
+        # after n-gram matches appear in the generated text (schedule()
+        # only re-evaluates spec eligibility between chains).
+        if (not self.scheduler.waiting and not inflight["zombies"]
+                and not self.scheduler.spec_enabled):
             successor = self._advance_window(inflight)
 
         with ph("device_fetch"):
@@ -1036,9 +1110,69 @@ class LLMEngine:
             outs = self._process_window(batch, toks_np, lps_np, zombies,
                                         defer=False, top_ids=top_i,
                                         top_lps=top_l)
-        self._last_step_info = ("mixed", batch.num_seqs, None,
-                                batch.prefill_token_count,
-                                batch.num_seqs - 1)
+        self._last_step_info = (
+            "mixed", batch.num_seqs, None,
+            {"prefill_tokens": batch.prefill_token_count,
+             "decode_tokens": batch.num_seqs - 1})
+        return outs
+
+    def _step_spec(self, batch: ScheduledBatch, float_b,
+                   step_key) -> list[RequestOutput]:
+        """Execute one speculative-verification step and commit its
+        results: every row advances by ``accepted + 1`` tokens (the
+        accepted draft prefix plus the resample-or-bonus token), appended
+        through the regular stop-check loop so EOS/max_tokens mid-window
+        truncate exactly as in the decode path. Spec steps are synchronous
+        (the next step's drafts depend on this one's accepted tokens), so
+        finished rows release pages immediately. Rejected drafts need NO
+        device-side rollback: their KV slots sit past the new committed
+        length and the next step's append overwrites them before any read
+        (the verifier module documents the invariant; tests pin it)."""
+        ph = self.obs.phases.phase
+        R_pad = batch.page_tables.shape[0]
+        S = len(batch.tokens) // R_pad
+        with ph("host_prep"):
+            int_t = jnp.asarray(np.stack(
+                [batch.tokens, batch.seg_ids, batch.positions,
+                 batch.slot_mapping]))
+            int_b = jnp.asarray(np.stack(
+                [batch.top_k, batch.seed, batch.top_n], axis=1))
+            page_tables = jnp.asarray(batch.page_tables)
+            context_lens = jnp.asarray(batch.context_lens)
+            out_tokens = self._penalty_out_tokens(batch)
+            bias_ids, bias_vals = self._bias_arrays(batch)
+        with ph("device_dispatch"):
+            (toks, n_acc, lps, tids, tlps,
+             self.kv_cache) = self._spec_verify_fn(
+                self.params, self.kv_cache, int_t, int_b, float_b,
+                page_tables, context_lens, out_tokens, bias_ids, bias_vals,
+                step_key)
+        with ph("device_fetch"):
+            toks_np = np.asarray(toks)
+            n_acc_np = np.asarray(n_acc)
+            lps_np = np.asarray(lps)
+            top_i = top_l = None
+            if any(s.params.top_logprobs for s in batch.seqs):
+                top_i = np.asarray(tids)
+                top_l = np.asarray(tlps)
+        B = batch.num_seqs
+        emit = np.minimum(n_acc_np + 1, S)
+        # Acceptance metrics count REAL proposals only: rows short of k
+        # were padded with filler drafts (lossless but not "drafted" in
+        # any operator-meaningful sense), so both the drafted and the
+        # accepted tallies clamp to draft_lens — kgct_spec_acceptance_ratio
+        # measures the proposer, not the padding.
+        draft_lens = batch.draft_lens[:B]
+        drafted = int(draft_lens.sum())
+        accepted = int(np.minimum(n_acc_np[:B], draft_lens).sum())
+        greedy = bool(np.all(batch.temperature[:B] <= 0))
+        with ph("postproc"):
+            outs = self._process_window(batch, toks_np, lps_np, set(),
+                                        defer=False, top_ids=top_i,
+                                        top_lps=top_l, emit_counts=emit)
+        self._last_step_info = (
+            "spec", B, "greedy" if greedy else "sampled",
+            {"drafted_tokens": drafted, "accepted_tokens": accepted})
         return outs
 
     def _bias_arrays(self, batch: ScheduledBatch):
@@ -1166,6 +1300,7 @@ class LLMEngine:
                         logprobs: np.ndarray, zombies: set,
                         defer: bool, top_ids: Optional[np.ndarray] = None,
                         top_lps: Optional[np.ndarray] = None,
+                        emit_counts: Optional[np.ndarray] = None,
                         ) -> list[RequestOutput]:
         """next_tokens/logprobs: [B_pad, W]. Append window tokens per sequence
         until a stop condition fires; tokens generated past the stop are
@@ -1173,6 +1308,8 @@ class LLMEngine:
         ``zombies`` (request ids finished in an earlier chained window) are
         skipped; with ``defer`` the pages of newly finished sequences are held
         until the chain drains (an in-flight window may still write to them).
+        ``emit_counts`` [B_pad] caps the usable columns per row (spec steps:
+        accepted drafts + 1; slots past the first rejection are garbage).
         """
         outputs = []
         for s, seq in enumerate(batch.seqs):
@@ -1184,7 +1321,10 @@ class LLMEngine:
             new_tokens: list[int] = []
             new_lps: list[float] = []
             new_tops: list[list[tuple[int, float]]] = []
-            for j, (token, lp) in enumerate(zip(next_tokens[s], logprobs[s])):
+            width = (next_tokens.shape[1] if emit_counts is None
+                     else int(emit_counts[s]))
+            for j, (token, lp) in enumerate(zip(next_tokens[s][:width],
+                                                logprobs[s][:width])):
                 token = int(token)
                 # Per-request gating: the device computes logprobs
                 # unconditionally (negligible next to sampling), but the

@@ -62,6 +62,9 @@ class ScheduledBatch:
     # the actual (unpadded) chunk token count for stats/observability.
     chunk_page_table: Optional[np.ndarray] = None  # [1, hist_width]
     prefill_token_count: int = 0
+    # spec only: per-row count of REAL n-gram proposals (rows short of k
+    # were padded with filler drafts; the split feeds acceptance metrics).
+    draft_lens: Optional[np.ndarray] = None        # [B_pad]
     # sampling arrays [B_pad]
     temperature: Optional[np.ndarray] = None
     top_k: Optional[np.ndarray] = None
@@ -100,6 +103,14 @@ class Scheduler:
         # The engine may clear this after construction when the mesh regime
         # has no mixed forward path (pp/sp).
         self.mixed_enabled = sc.mixed_batch_enabled
+        # Speculative decoding (engine/spec/): pure-decode steps become
+        # batched draft-verification steps. The engine may clear this after
+        # construction (pp/sp meshes have no spec forward path).
+        self.spec_enabled = sc.spec_decode_enabled
+        self.spec_proposer = None
+        if sc.spec_decode_enabled:
+            from .spec.proposer import build_proposer
+            self.spec_proposer = build_proposer(sc)
         self.decode_buckets = sc.decode_buckets
         self.prefill_buckets = sc.prefill_buckets
         self.page_size = config.cache.page_size
@@ -218,6 +229,18 @@ class Scheduler:
         batch = self._schedule_prefills()
         if batch is not None:
             return batch
+        # Speculative decoding replaces the pure decode step when enabled:
+        # every running sequence's drafts verify in one dispatched program.
+        # Chunked prefill rows are never drafted (they never reach here —
+        # prefill work schedules above), and a bow-out (no proposals, rows
+        # out of the bucket grid, no pages) falls through to a legacy
+        # decode window — unchained while spec is enabled, so eligibility
+        # is re-checked every window (see engine._step).
+        if self.spec_enabled and self.running:
+            from .spec.verifier import build_spec_batch
+            batch = build_spec_batch(self)
+            if batch is not None:
+                return batch
         return self._schedule_decode()
 
     # Bounded lookahead past a blocked queue head: fills the batch with

@@ -41,6 +41,7 @@ from ..ops.attention import (
     paged_decode_attention,
     paged_decode_attention_tp,
     mixed_attention,
+    spec_verify_attention,
 )
 
 Params = dict[str, Any]
@@ -60,6 +61,20 @@ class DecodeMeta(NamedTuple):
     slot_mapping: jax.Array   # [B] int32 flat KV slot for the new token
     page_tables: jax.Array    # [B, pages_per_seq] int32 page ids (pad = scrap)
     context_lens: jax.Array   # [B] int32 valid tokens incl. the new one
+
+
+class SpecMeta(NamedTuple):
+    """Metadata for a speculative-verification step over one padded token
+    axis ``T = R_pad * S``: every running sequence contributes S = k+1
+    contiguous slots (its last committed token + k drafts), attending to
+    its own paged-pool history plus the earlier slice tokens causally.
+    The per-row slot count S is static per compiled shape
+    (``S = T // page_tables.shape[0]``)."""
+    seg_ids: jax.Array          # [T] int32: row id on real slots, -1 padding
+    positions: jax.Array        # [T] int32 global positions (RoPE input)
+    slot_mapping: jax.Array     # [T] int32 KV write slot (overflow -> scrap)
+    page_tables: jax.Array      # [R_pad, pages_bucket] per-row history pages
+    context_lens: jax.Array     # [R_pad] committed tokens incl. slot 0's
 
 
 class MixedMeta(NamedTuple):
@@ -558,6 +573,34 @@ def forward_mixed(params: Params, cfg: ModelConfig, tokens: jax.Array,
                                          meta.slot_mapping))
     selected = h[meta.logits_indices]
     return _norm(cfg, selected, params, "final_norm"), new_kv, h
+
+
+def forward_spec_verify(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                        meta: SpecMeta, kv: KVCache, use_pallas=None):
+    """Speculative-verification forward: ONE program scores every running
+    sequence's k drafted tokens. Embedding, QKV/MLP matmuls and norms run
+    over the flat ``[R_pad * S]`` token axis (the weight streaming a decode
+    step pays is amortized over all draft positions — the same economics
+    as mixed batching); attention runs the batched draft-verification
+    shape (ops.attention.spec_verify_attention: paged-pool history + an
+    S x S causal block per row). Returns (normed_hidden [T, d] over EVERY
+    slot — the verifier needs logits at all draft positions, not one
+    sampled row — new_kv, raw_hidden [T, d]). All new K/V (including
+    drafts that will be rejected) commit in the one post-scan scatter;
+    rejected slots sit past the sequence's committed length and are
+    overwritten before any later step reads them."""
+    scale = cfg.head_dim ** -0.5
+    h = _embed(params, cfg, tokens, meta.positions)
+
+    def attn_fn(lp, q, k, v, layer_idx):
+        return spec_verify_attention(
+            q, k, v, kv.k, kv.v, meta.page_tables, meta.context_lens, scale,
+            layer=layer_idx, use_pallas=use_pallas)
+
+    h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn)
+    new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
+                                         meta.slot_mapping))
+    return _norm(cfg, h, params, "final_norm"), new_kv, h
 
 
 def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,

@@ -74,9 +74,16 @@ class Observability:
         # Mixed (stall-free) batching: device steps by kind plus the
         # cumulative prefill/decode token split of mixed steps — feeds the
         # kgct_mixed_step_ratio gauge and the bench mixed readout.
-        self.step_kind_counts = {"prefill": 0, "decode": 0, "mixed": 0}
+        self.step_kind_counts = {"prefill": 0, "decode": 0, "mixed": 0,
+                                 "spec": 0}
         self.mixed_prefill_tokens = 0
         self.mixed_decode_tokens = 0
+        # Speculative decoding: cumulative drafted vs accepted draft tokens
+        # (bonus tokens excluded from both) — feeds the
+        # kgct_spec_acceptance_ratio gauge, the kgct_spec_*_tokens_total
+        # counters, and the bench speculative readout.
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
 
     # -- request lifecycle hooks (engine + scheduler) ------------------------
 
@@ -138,7 +145,8 @@ class Observability:
 
     def on_step(self, step: int, kind: str, batch: int, duration_s: float,
                 new_tokens: int, mode: str = None, prefill_tokens: int = 0,
-                decode_tokens: int = 0) -> None:
+                decode_tokens: int = 0, drafted_tokens: int = 0,
+                accepted_tokens: int = 0) -> None:
         self.step_duration.observe(duration_s)
         self.batch_size.observe(batch)
         self.phases.end_step(step=step, kind=kind, batch=batch,
@@ -159,6 +167,15 @@ class Observability:
             self.tracer.emit("mixed", "", batch=batch,
                              prefill_tokens=prefill_tokens,
                              decode_tokens=decode_tokens)
+        elif kind == "spec":
+            # The speculative-decoding signal: of the drafts this step
+            # verified, how many committed (emitted tokens = accepted +
+            # one bonus per row; new_tokens carries the realized total).
+            self.spec_drafted_tokens += drafted_tokens
+            self.spec_accepted_tokens += accepted_tokens
+            self.tracer.emit("spec", "", batch=batch, tokens=new_tokens,
+                             drafted=drafted_tokens, accepted=accepted_tokens,
+                             mode=mode or "greedy")
 
     def mixed_step_ratio(self):
         """Fraction of device steps that were mixed prefill/decode steps, or
@@ -170,6 +187,16 @@ class Observability:
         if total <= 0:
             return None
         return self.step_kind_counts["mixed"] / total
+
+    def spec_acceptance_ratio(self):
+        """accepted/drafted draft tokens over all spec steps, or None
+        before any spec step ran. The capacity signal for n-gram drafting:
+        near-0 means the workload has no lookup structure (spec steps are
+        pure overhead — disable or switch proposers); the bench's
+        repetitive-suffix phase expects it high."""
+        if self.spec_drafted_tokens <= 0:
+            return None
+        return self.spec_accepted_tokens / self.spec_drafted_tokens
 
     def sampled_decode_ratio(self):
         """sampled/greedy decode tok/s ratio, or None until both modes have
@@ -214,6 +241,14 @@ class Observability:
         lines.append("# TYPE kgct_mixed_decode_tokens_total counter")
         lines.append("kgct_mixed_decode_tokens_total %d"
                      % self.mixed_decode_tokens)
+        lines.extend(render_gauge("kgct_spec_acceptance_ratio",
+                                  self.spec_acceptance_ratio()))
+        lines.append("# TYPE kgct_spec_drafted_tokens_total counter")
+        lines.append("kgct_spec_drafted_tokens_total %d"
+                     % self.spec_drafted_tokens)
+        lines.append("# TYPE kgct_spec_accepted_tokens_total counter")
+        lines.append("kgct_spec_accepted_tokens_total %d"
+                     % self.spec_accepted_tokens)
         return lines
 
     def export_perfetto(self) -> dict:

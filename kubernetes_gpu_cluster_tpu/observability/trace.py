@@ -21,12 +21,13 @@ import time
 from collections import deque
 from typing import Optional
 
-# Typed event kinds (the request lifecycle, in rough order). "decode" and
-# "mixed" are engine-wide per-step events (empty request id); a "mixed"
-# event carries the step's prefill/decode token split.
+# Typed event kinds (the request lifecycle, in rough order). "decode",
+# "mixed" and "spec" are engine-wide per-step events (empty request id); a
+# "mixed" event carries the step's prefill/decode token split, a "spec"
+# event the drafted/accepted draft-token counts.
 EVENT_KINDS = ("arrival", "queued", "scheduled", "prefill_chunk",
-               "first_token", "decode", "mixed", "preempt", "resume",
-               "finish", "abort")
+               "first_token", "decode", "mixed", "spec", "preempt",
+               "resume", "finish", "abort")
 
 # Events that OPEN / CLOSE a request's async span in the Perfetto export.
 _OPEN = "arrival"

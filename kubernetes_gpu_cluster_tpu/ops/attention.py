@@ -252,6 +252,89 @@ def paged_decode_attention_xla(
 
 
 # ---------------------------------------------------------------------------
+# Speculative-verification attention
+# ---------------------------------------------------------------------------
+
+def spec_verify_attention_xla(
+    q: jax.Array,            # [B*S, n_heads, hd] (post-RoPE), row-major rows
+    k: jax.Array,            # [B*S, n_kv, hd] this step's keys (incl. drafts)
+    v: jax.Array,            # [B*S, n_kv, hd]
+    k_pool: jax.Array,       # [P, ps, n_kv*hd] or [L, P, ps, n_kv*hd]
+    v_pool: jax.Array,
+    page_tables: jax.Array,  # [B, pages_per_seq] int32 page ids (pad = scrap)
+    context_lens: jax.Array, # [B] committed tokens incl. the slice's first
+    scale: float,
+    layer: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Batched draft verification: B sequences, S = k+1 tokens each
+    (``[last committed token, k drafts]``), every token attending to its
+    sequence's paged-pool history PLUS the earlier slice tokens causally.
+
+    This is ``paged_decode_attention_xla`` generalized from one query/row to
+    S queries/row — the pool gather is identical; the "current token" term
+    becomes an S x S causal block. The pool holds positions
+    0..context_len-2 (the slice's own K/V arrive in-batch and are committed
+    by the caller's post-scan scatter, the same pre-write contract as every
+    other path). Draft slots past the model cap were routed to the scrap
+    page by the scheduler; their outputs are garbage the host discards.
+
+    XLA implementation — correct everywhere, GSPMD-partitionable under tp
+    meshes (heads shard like the other reference paths). A Pallas kernel
+    (streaming only valid pages, S queries per DMA block) is the natural
+    upgrade once spec decode is TPU-bench-proven; the dispatcher below
+    keeps the seam.
+    """
+    if layer is not None and k_pool.ndim == 4:
+        k_pool = jax.lax.dynamic_index_in_dim(k_pool, layer, 0, keepdims=False)
+        v_pool = jax.lax.dynamic_index_in_dim(v_pool, layer, 0, keepdims=False)
+    B = page_tables.shape[0]
+    T, n_heads, hd = q.shape
+    S = T // B
+    n_kv = k.shape[1]
+    ps = k_pool.shape[1]
+    L = page_tables.shape[1] * ps
+    q_per_kv = n_heads // n_kv
+
+    k_seq = k_pool[page_tables].reshape(B, L, n_kv, hd).astype(jnp.float32)
+    v_seq = v_pool[page_tables].reshape(B, L, n_kv, hd).astype(jnp.float32)
+
+    qg = (q.astype(jnp.float32) * scale).reshape(B, S, n_kv, q_per_kv, hd)
+    kf = k.astype(jnp.float32).reshape(B, S, n_kv, hd)
+    vf = v.astype(jnp.float32).reshape(B, S, n_kv, hd)
+
+    # History scores: every slice token sees the committed pool positions
+    # 0..context_len-2 (identical mask for all S queries of a row).
+    s_h = jnp.einsum("bskgh,blkh->bkgsl", qg, k_seq)      # [B,n_kv,g,S,L]
+    valid_h = jnp.arange(L)[None, :] < (context_lens - 1)[:, None]  # [B, L]
+    s_h = jnp.where(valid_h[:, None, None, None, :], s_h, -jnp.inf)
+    # In-slice scores: causal within the row's S tokens (the slice is
+    # contiguous append-order, so a static lower-triangular mask suffices).
+    s_b = jnp.einsum("bskgh,btkh->bkgst", qg, kf)         # [B,n_kv,g,S,S]
+    causal = jnp.tril(jnp.ones((S, S), bool))
+    s_b = jnp.where(causal[None, None, None], s_b, -jnp.inf)
+
+    s = jnp.concatenate([s_h, s_b], axis=-1)              # [B,n_kv,g,S,L+S]
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)                   # padding rows
+    out = (jnp.einsum("bkgsl,blkh->bskgh", p[..., :L], v_seq)
+           + jnp.einsum("bkgst,btkh->bskgh", p[..., L:], vf))
+    return out.reshape(T, n_heads, hd).astype(q.dtype)
+
+
+def spec_verify_attention(q, k, v, k_pool, v_pool, page_tables, context_lens,
+                          scale, *, layer=None, use_pallas=None):
+    """Spec-verify dispatcher. No Pallas kernel exists yet — every backend
+    takes the XLA path (on TPU it runs as plain XLA inside the jitted step,
+    exactly like chunked-prefill history attention did before its kernel
+    landed; under a GSPMD tp mesh the partitioner shards it over heads).
+    ``use_pallas`` is accepted so the call sites are already wired for the
+    kernel when it lands."""
+    del use_pallas
+    return spec_verify_attention_xla(q, k, v, k_pool, v_pool, page_tables,
+                                     context_lens, scale, layer=layer)
+
+
+# ---------------------------------------------------------------------------
 # Dispatchers (Pallas on TPU, XLA elsewhere)
 # ---------------------------------------------------------------------------
 

@@ -712,15 +712,30 @@ def main(argv: Optional[list[str]] = None) -> None:
                    help="reuse KV pages across requests sharing a "
                    "page-aligned prompt prefix (vLLM parity)")
     p.add_argument("--enable-mixed-batch", action="store_true",
-                   help="stall-free mixed prefill/decode batching "
-                   "(Sarathi-style): each device step carries all running "
-                   "decode tokens plus a budgeted chunk of the queue-head "
-                   "prompt, so prefills stop stalling decode and decode "
-                   "stops starving prefill")
+                   help="accepted for back-compat: stall-free mixed "
+                   "prefill/decode batching is now the DEFAULT (each "
+                   "device step carries all running decode tokens plus a "
+                   "budgeted chunk of the queue-head prompt); opt out "
+                   "with --disable-mixed-batch")
+    p.add_argument("--disable-mixed-batch", action="store_true",
+                   help="revert to the legacy prefill-else-decode "
+                   "scheduler policy (prefills stall decode for whole "
+                   "steps; the pre-mixing behavioral baseline)")
     p.add_argument("--decode-priority-token-budget", type=int, default=None,
                    help="per-mixed-step token budget; decode rows claim "
                    "theirs first, the prefill chunk fills the remainder "
                    "(default: max_prefill_tokens)")
+    p.add_argument("--enable-spec-decode", action="store_true",
+                   help="speculative decoding: n-gram/prompt-lookup "
+                   "drafting (no draft model) + single-dispatch batched "
+                   "verification with lossless acceptance — greedy output "
+                   "is byte-identical, sampled output keeps the target "
+                   "distribution; wins are workload-dependent (watch "
+                   "kgct_spec_acceptance_ratio)")
+    p.add_argument("--num-speculative-tokens", type=int, default=4,
+                   help="draft length k per spec step (static compile "
+                   "shape; each verify step scores k+1 positions per "
+                   "sequence)")
     p.add_argument("--enforce-eager", action="store_true",
                    help="disable jit compile caching (debug; always slower)")
     p.add_argument("--trust-remote-code", action="store_true",
@@ -763,8 +778,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         scheduler=SchedulerConfig(
             max_num_seqs=args.max_num_seqs,
             enable_prefix_caching=args.enable_prefix_caching,
-            mixed_batch_enabled=args.enable_mixed_batch,
-            decode_priority_token_budget=args.decode_priority_token_budget),
+            mixed_batch_enabled=not args.disable_mixed_batch,
+            decode_priority_token_budget=args.decode_priority_token_budget,
+            spec_decode_enabled=args.enable_spec_decode,
+            num_speculative_tokens=args.num_speculative_tokens),
         parallel=ParallelConfig(tp=args.tensor_parallel_size,
                                 pp=args.pipeline_parallel_size,
                                 sp=args.sequence_parallel_size,

@@ -18,13 +18,18 @@ from kubernetes_gpu_cluster_tpu.config import (CacheConfig, EngineConfig,
 from kubernetes_gpu_cluster_tpu.engine import LLMEngine, SamplingParams
 
 
-def _engine(max_prefill_tokens, max_num_seqs=4, num_pages=129):
+def _engine(max_prefill_tokens, max_num_seqs=4, num_pages=129, mixed=False):
+    # These are LEGACY-policy pins (solo-chunk admission, lookahead,
+    # preemption ordering), so mixing is pinned off explicitly now that
+    # mixed batching is the SchedulerConfig default; the mixed-policy
+    # equivalents live in tests/test_mixed_batch.py.
     cfg = EngineConfig(
         model=get_model_config("debug-tiny"),
         cache=CacheConfig(page_size=8, num_pages=num_pages),
         scheduler=SchedulerConfig(
             max_num_seqs=max_num_seqs, max_prefill_tokens=max_prefill_tokens,
-            decode_buckets=(1, 2, 4), prefill_buckets=(32, 64, 128, 256)))
+            decode_buckets=(1, 2, 4), prefill_buckets=(32, 64, 128, 256),
+            mixed_batch_enabled=mixed))
     return LLMEngine(cfg)
 
 

@@ -43,7 +43,7 @@ def _compiled_variants(eng) -> int:
     distinct XLA compilations the load has triggered."""
     total = 0
     for fn in (eng._prefill_fn, eng._prefill_hist_fn, eng._mixed_fn,
-               eng._decode_fn, eng._decode_fn_greedy):
+               eng._decode_fn, eng._decode_fn_greedy, eng._spec_verify_fn):
         if fn is not None and hasattr(fn, "_cache_size"):
             total += fn._cache_size()
     return total
@@ -91,3 +91,59 @@ def test_mixed_load_compile_count_bounded():
     _run_wave(eng, "w2")
     assert _compiled_variants(eng) == first, \
         "second identical load wave triggered new XLA compilations"
+
+
+def _spec_engine(k: int = 3):
+    cfg = EngineConfig(
+        model=get_model_config("debug-tiny"),
+        cache=CacheConfig(page_size=8, num_pages=129),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_prefill_tokens=32,
+            decode_buckets=DECODE_BUCKETS, prefill_buckets=PREFILL_BUCKETS,
+            decode_window=2, mixed_batch_enabled=True,
+            spec_decode_enabled=True, num_speculative_tokens=k))
+    return LLMEngine(cfg)
+
+
+def _run_spec_wave(eng, tag: str) -> None:
+    """Mixed spec load: repetitive prompts (n-gram drafts hit, spec steps
+    fire at several row buckets) plus structureless ones (spec bows out to
+    legacy decode), staggered so prefill/mixed/spec/decode all occur."""
+    rng = np.random.default_rng(1)
+    pattern = rng.integers(1, 500, 4).tolist()
+    prompts = [pattern * 4, rng.integers(1, 500, 12).tolist(),
+               pattern * 7, pattern * 2, rng.integers(1, 500, 30).tolist()]
+    params = SamplingParams(max_tokens=8, temperature=0.0)
+    pending = [(f"{tag}-{i}", list(p)) for i, p in enumerate(prompts)]
+    while pending or eng.has_unfinished_requests():
+        if pending:
+            rid, prompt = pending.pop(0)
+            eng.add_request(rid, prompt, params)
+        for _ in range(3):
+            if eng.has_unfinished_requests():
+                eng.step()
+    while eng.has_unfinished_requests():
+        eng.step()
+
+
+def test_spec_load_compile_count_bounded():
+    """Spec-decode steps stay inside the bucket-grid compile bound: the
+    verify program's token width is R_pad * (k+1) with k STATIC config, so
+    it adds at most one variant per decode bucket — and a second identical
+    spec wave compiles NOTHING new."""
+    eng = _spec_engine()
+    _run_spec_wave(eng, "w1")
+    assert eng.obs.step_kind_counts["spec"] > 0, \
+        "simulation never exercised a spec-verify step"
+    first = _compiled_variants(eng)
+    n_tp, n_rows = len(PREFILL_BUCKETS), len(DECODE_BUCKETS)
+    bound = (n_tp * n_rows          # pure prefill
+             + n_tp * n_rows * 3    # mixed
+             + n_tp * 3             # solo chunk
+             + n_rows * 2           # decode greedy/sampled
+             + n_rows)              # spec verify: one per row bucket
+    assert 0 < first <= bound, (first, bound)
+
+    _run_spec_wave(eng, "w2")
+    assert _compiled_variants(eng) == first, \
+        "second identical spec wave triggered new XLA compilations"

@@ -107,9 +107,10 @@ def test_engine_deployment_shape():
 
 
 def test_mixed_batch_knobs_map_to_engine_flags():
-    """vllmConfig.enableMixedBatch / decodePriorityTokenBudget render to the
-    API server's --enable-mixed-batch / --decode-priority-token-budget (the
-    stall-free TTFT scheduler's deployment surface)."""
+    """Mixed batching is the engine DEFAULT now: absent/true render no
+    flag; an explicit ``enableMixedBatch: false`` renders the
+    --disable-mixed-batch opt-out; decodePriorityTokenBudget renders
+    whenever set."""
     values = copy.deepcopy(VALUES)
     cfg = values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"]
     cfg["enableMixedBatch"] = True
@@ -117,13 +118,43 @@ def test_mixed_batch_knobs_map_to_engine_flags():
     ms = render_values(values)
     args = ms["qwen3-engine-deployment.yaml"][
         "spec"]["template"]["spec"]["containers"][0]["args"]
-    assert "--enable-mixed-batch" in args
+    assert "--enable-mixed-batch" not in args       # default, no flag needed
+    assert "--disable-mixed-batch" not in args
     assert args[args.index("--decode-priority-token-budget") + 1] == "1536"
-    # and absent when the values file does not opt in
+    # default values file: mixing on by engine default, nothing rendered
     ms = render_values(copy.deepcopy(VALUES))
     args = ms["qwen3-engine-deployment.yaml"][
         "spec"]["template"]["spec"]["containers"][0]["args"]
     assert "--enable-mixed-batch" not in args
+    assert "--disable-mixed-batch" not in args
+    # explicit opt-out renders the disable flag
+    values = copy.deepcopy(VALUES)
+    values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"][
+        "enableMixedBatch"] = False
+    ms = render_values(values)
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--disable-mixed-batch" in args
+
+
+def test_spec_decode_knobs_map_to_engine_flags():
+    """vllmConfig.enableSpecDecode / numSpeculativeTokens render to the API
+    server's --enable-spec-decode / --num-speculative-tokens (the
+    speculative-decoding deployment surface); absent renders nothing."""
+    values = copy.deepcopy(VALUES)
+    cfg = values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"]
+    cfg["enableSpecDecode"] = True
+    cfg["numSpeculativeTokens"] = 6
+    ms = render_values(values)
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--enable-spec-decode" in args
+    assert args[args.index("--num-speculative-tokens") + 1] == "6"
+    ms = render_values(copy.deepcopy(VALUES))
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--enable-spec-decode" not in args
+    assert "--num-speculative-tokens" not in args
 
 
 def test_engine_pod_graceful_drain_contract():

@@ -13,8 +13,9 @@ import numpy as np
 import pytest
 
 from kubernetes_gpu_cluster_tpu.ops.sampling import (
-    TOP_K_CAP, _apply_filters, apply_penalties, build_counts, bump_counts,
-    row_sample_keys, sample_and_logprobs, sample_tokens, token_logprobs)
+    TOP_K_CAP, TOP_K_CAP_WIDE, _apply_filters, apply_penalties, build_counts,
+    bump_counts, row_sample_keys, sample_and_logprobs, sample_tokens,
+    token_logprobs)
 
 
 def reference_filter(scaled, top_k, top_p):
@@ -105,6 +106,37 @@ def test_tied_kth_value_matches_reference():
     # p(top)=0.731 >= 0.7 under the exact 2-token renormalizer => keep only
     # the argmax.
     assert np.isfinite(np.asarray(got)[0]).sum() == 1
+
+
+def test_wide_tier_matches_reference():
+    """top_k in (TOP_K_CAP, TOP_K_CAP_WIDE]: the second-tier lax.top_k
+    window (which replaced the immediate full-vocab sort on big-vocab
+    models) must match the full-sort oracle exactly. V > TOP_K_CAP_WIDE so
+    the wide tier is actually live, heterogeneous rows so tier-1-resolvable
+    rows ride along through the batch-global tier-2 cond."""
+    rng = np.random.default_rng(7)
+    B, V = 6, TOP_K_CAP_WIDE + 512
+    scaled = _peaked_logits(rng, B, V)
+    tk = jnp.asarray([300, 1000, TOP_K_CAP_WIDE, TOP_K_CAP + 1, 0, 50],
+                     jnp.int32)
+    tp = jnp.asarray([1.0, 0.95, 0.5, 1.0, 0.9, 0.9], jnp.float32)
+    got = _apply_filters(scaled, tk, tp)
+    want = reference_filter(scaled, tk, tp)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_beyond_wide_tier_falls_back_to_exact_sort():
+    """Rows the wide window cannot resolve (top_k > TOP_K_CAP_WIDE, or a
+    near-uniform top-p prefix wider than it) still take the exact full-sort
+    path and match the oracle."""
+    rng = np.random.default_rng(8)
+    B, V = 4, TOP_K_CAP_WIDE + 512
+    scaled = jnp.asarray(rng.standard_normal((B, V)).astype(np.float32)) * 0.01
+    tk = jnp.asarray([TOP_K_CAP_WIDE + 100, 0, 40, 2500], jnp.int32)
+    tp = jnp.asarray([1.0, 0.95, 0.9, 0.99], jnp.float32)
+    got = _apply_filters(scaled, tk, tp)
+    want = reference_filter(scaled, tk, tp)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 def test_small_vocab_uses_full_sort():
