@@ -115,7 +115,19 @@ Output contract (the driver's official record depends on it):
   stdout noise is flushed before the result so interleaving cannot split
   the line. Consumers must parse ONLY that last line (parse_result_line()
   implements this), never scan stdout for something JSON-shaped.
+
+  The result line is BOUNDED to RESULT_LINE_MAX bytes: capture harnesses
+  keep only a stdout TAIL (the r5 record kept 2000 chars and decapitated
+  an oversized result line into "parsed": null). When the full result
+  would exceed the bound, the bulky per-config detail ("configs") moves to
+  stderr as a FULL_RESULT line and the stdout result keeps every headline
+  field plus "configs_on_stderr": true.
 """
+
+# The driver's transcript tail window is 2000 chars (BENCH_r05.json);
+# bound the result line well under it so a tail capture can never cut the
+# line's head off again. tests/test_bench_contract.py pins this.
+RESULT_LINE_MAX = 1600
 
 
 def _mk_engine(model_name: str, quant, batch: int, max_new: int,
@@ -794,14 +806,58 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return p
 
 
+# Headline blocks droppable (in order) when the result line must shrink
+# further than losing "configs" — the primary metric/value/unit always stay.
+_DROPPABLE_HEADLINE = ("ttft_decomposition", "baseline_bar", "mixed_batch",
+                       "sampled_over_greedy", "spec_acceptance_ratio",
+                       "decode_window", "prefill_budget", "vs_baseline")
+
+
+def compact_result(out: dict, limit: int = RESULT_LINE_MAX) -> dict:
+    """Shrink ``out`` until its JSON line fits ``limit`` bytes (the driver
+    keeps only a stdout tail — an oversized line gets its HEAD cut off and
+    parses to nothing, the BENCH_r05 "parsed": null failure mode). Degrades
+    in stages, never fails: drop "configs" (the caller preserves it on
+    stderr), then droppable headline blocks, and as a last resort a
+    minimal bounded {metric, value, unit} record — a shrunk result always
+    beats a decapitated or absent one."""
+    line = json.dumps(out)
+    if len(line) <= limit:
+        return out
+    slim = dict(out)
+    slim.pop("configs", None)
+    slim["configs_on_stderr"] = True
+    for key in _DROPPABLE_HEADLINE:
+        if len(json.dumps(slim)) <= limit:
+            return slim
+        slim.pop(key, None)
+    if len(json.dumps(slim)) <= limit:
+        return slim
+    return {"metric": str(out.get("metric"))[:256], "value": out.get("value"),
+            "unit": out.get("unit"), "configs_on_stderr": True}
+
+
 def emit_result(out: dict) -> None:
     """Emit the result as the GUARANTEED last stdout line: json.dumps with
-    no embedded newlines, everything previously buffered flushed first, one
-    write, one flush. All framework logging already goes to stderr
-    (utils/logging.py); anything a library printed earlier is flushed ahead
-    of the result so interleaving cannot split the line."""
-    line = json.dumps(out)
-    assert "\n" not in line
+    no embedded newlines AND no more than RESULT_LINE_MAX bytes (a tail
+    capture must never decapitate it — see compact_result), everything
+    previously buffered flushed first, one write, one flush. All framework
+    logging already goes to stderr (utils/logging.py); anything a library
+    printed earlier is flushed ahead of the result so interleaving cannot
+    split the line. When the full result exceeds the bound, it is emitted
+    intact on stderr as a FULL_RESULT line first."""
+    slim = compact_result(out)
+    if slim is not out:
+        sys.stderr.write("FULL_RESULT: " + json.dumps(out) + "\n")
+    line = json.dumps(slim)
+    # Explicit check, not assert (python -O must not strip the guarantee);
+    # unreachable — compact_result's minimal fallback is bounded — but if
+    # an invariant ever breaks, fail LOUD before a decapitated record can
+    # masquerade as a parse bug downstream.
+    if "\n" in line or len(line) > RESULT_LINE_MAX:
+        raise RuntimeError(
+            f"bench result line violates the stdout contract "
+            f"({len(line)} bytes > {RESULT_LINE_MAX} or embedded newline)")
     sys.stderr.flush()
     sys.stdout.flush()
     sys.stdout.write(line + "\n")

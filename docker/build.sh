@@ -24,6 +24,18 @@ while [[ $# -gt 0 ]]; do
   esac
 done
 
+# Quality gate BEFORE any image build: kgct-lint (empty baseline) + tier-1
+# tests (scripts/check.sh) — an image can never ship lint-dirty code.
+# KGCT_SKIP_CHECKS=1 is the explicit, logged escape hatch (e.g. building
+# on a host without the test toolchain); KGCT_CHECK_ARGS="--lint-only"
+# keeps the gate but skips the test run.
+if [[ "${KGCT_SKIP_CHECKS:-0}" != 1 ]]; then
+  # shellcheck disable=SC2086
+  "${REPO_ROOT}/scripts/check.sh" ${KGCT_CHECK_ARGS:-}
+else
+  echo ">> WARNING: KGCT_SKIP_CHECKS=1 — building without lint/test gate" >&2
+fi
+
 build() {
   local name="$1" dockerfile="$2"
   local image="${REGISTRY}/${name}:${TAG}"

@@ -1,0 +1,26 @@
+"""kgct static analysis + runtime sanitizers.
+
+Two complementary halves guard the serving engine's hot-path invariants —
+the properties no functional test can see until they break in production
+(a silent recompile, a hidden host sync, a read of a donated buffer, a
+stale KV slot surviving a speculative rollback):
+
+- ``kgct-lint`` (:mod:`.core`, :mod:`.rules`, :mod:`.cli`): an AST-based
+  lint framework with JAX-aware rules, run over the package by a tier-1
+  test with an EMPTY findings baseline — a new violation fails tests, not
+  prod. No jax import, no allowlist: every rule holds everywhere.
+- runtime sanitizers (:mod:`.sanitize`, ``KGCT_SANITIZE=1``):
+  checkify-style NaN/inf guards on step outputs plus a KV-slot shadow
+  asserting the spec-decode rollback contract dynamically. Wired into the
+  ``KGCT_FAULT`` chaos harness so the detectors themselves are tested.
+"""
+
+from .core import Finding, LintModule, Rule, iter_py_files, run_lint
+from .rules import ALL_RULES, rules_by_code
+from .sanitize import SanitizerError, StepSanitizer, build_step_sanitizer
+
+__all__ = [
+    "ALL_RULES", "Finding", "LintModule", "Rule", "SanitizerError",
+    "StepSanitizer", "build_step_sanitizer", "iter_py_files", "run_lint",
+    "rules_by_code",
+]

@@ -1,0 +1,7 @@
+"""``python -m kubernetes_gpu_cluster_tpu.analysis`` == ``kgct-lint``."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,91 @@
+"""kgct-lint CLI: run the JAX-aware rule suite over source trees.
+
+Exit codes: 0 clean, 1 findings, 2 usage error. The tier-1 test
+(tests/test_lint_clean.py) and scripts/check.sh both drive the same
+:func:`run_lint` this wraps, so CLI, CI and the docker build gate can
+never disagree on what "clean" means. No allowlist flag exists on
+purpose: a finding is fixed, not suppressed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core import run_lint
+from .rules import ALL_RULES, rules_by_code
+
+# Default lint scope: the package itself (this file's grandparent) plus the
+# repo-root bench script when invoked from a checkout.
+PACKAGE_ROOT = Path(__file__).resolve().parents[1]
+
+
+def default_paths() -> list:
+    paths = [PACKAGE_ROOT]
+    bench = PACKAGE_ROOT.parent / "bench.py"
+    if bench.is_file():
+        paths.append(bench)
+    return paths
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kgct-lint",
+        description=("JAX-aware static analysis for the serving engine: "
+                     "trace safety, hot-path host syncs, recompile risk, "
+                     "donation safety, KV commit safety, asyncio/metric/"
+                     "logging hygiene. Zero-findings is the enforced "
+                     "baseline (tests/test_lint_clean.py)."))
+    p.add_argument("paths", nargs="*", type=Path,
+                   help="files/directories to lint (default: the installed "
+                        "package + bench.py)")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule codes or names to run "
+                        "(default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="findings output format (default: text)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.code}  {rule.name:<22} {rule.description}")
+        return 0
+
+    try:
+        rules = (rules_by_code(args.select.split(","))
+                 if args.select else None)
+    except ValueError as e:
+        print(f"kgct-lint: {e}", file=sys.stderr)
+        return 2
+
+    paths = args.paths or default_paths()
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(f"kgct-lint: no such path: "
+              f"{', '.join(str(m) for m in missing)}", file=sys.stderr)
+        return 2
+
+    root = Path.cwd()
+    findings = run_lint(paths, rules=rules, root=root)
+
+    if args.format == "json":
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+    n_rules = len(rules) if rules is not None else len(ALL_RULES)
+    print(f"kgct-lint: {len(findings)} finding(s) "
+          f"({n_rules} rule(s))", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,411 @@
+"""kgct-lint core: module model, shared JAX-aware analyses, runner.
+
+Design constraints:
+
+- Pure :mod:`ast` — the linter never imports jax (or the linted modules),
+  so it runs in milliseconds anywhere, including the docker build host and
+  a fresh CI container with no accelerator stack.
+- Shared analyses live HERE and are computed once per module
+  (:class:`LintModule` caches them): which functions are jitted and with
+  what static/donated args, which methods are reachable from the engine
+  step hot path, which statements sit inside a sanctioned
+  ``with ph("device_fetch")`` sync window. Rules stay small and
+  declarative on top.
+- Sound-where-it-matters, syntactic everywhere else: every rule is an
+  approximation of a semantic property (trace purity, donation lifetime,
+  …). Approximations here are tuned to ZERO findings on invariant-holding
+  code — the tier-1 baseline test enforces an empty baseline with no
+  allowlist, so a rule that cries wolf cannot ship.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from functools import cached_property
+from pathlib import Path
+from typing import Iterable, Iterator, Optional
+
+# Attribute accesses on a traced value that yield TRACE-TIME-STATIC data
+# (python ints/dtypes): branching on these inside jit is fine.
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "itemsize"})
+
+# Builtins whose result on a traced array is static at trace time.
+STATIC_CALLS = frozenset({"len", "isinstance", "type"})
+
+# Functions that wrap a callable in a compiled program. ``_maybe_jit`` is
+# the engine's eager-mode-aware wrapper; treating it as jit keeps the rules
+# honest in the configuration that actually serves.
+JIT_WRAPPER_ATTRS = frozenset({"jit", "_maybe_jit"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+    rule: str       # e.g. "KGCT001"
+    name: str       # e.g. "trace-safety"
+    path: str       # repo-relative when a root was given
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.name}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class JittedFn:
+    """A function that compiles into an XLA program: the def (or lambda)
+    plus the jit call's static/donated argument declarations."""
+    node: ast.AST                     # FunctionDef | Lambda
+    call: Optional[ast.Call]          # the jax.jit/_maybe_jit call, if any
+    static_names: frozenset
+    donate_argnums: tuple
+
+    @property
+    def params(self) -> list:
+        args = self.node.args
+        return ([a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+                + [a.arg for a in args.kwonlyargs])
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute chains, 'jit' for Names, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_jit_wrapper(func: ast.AST) -> bool:
+    """Does this callee expression compile its first argument?"""
+    if isinstance(func, ast.Attribute):
+        return func.attr in JIT_WRAPPER_ATTRS
+    if isinstance(func, ast.Name):
+        return func.id in JIT_WRAPPER_ATTRS
+    # functools.partial(jax.jit, ...) as a decorator
+    if isinstance(func, ast.Call) and _dotted(func.func).endswith("partial"):
+        return bool(func.args) and is_jit_wrapper(func.args[0])
+    return False
+
+
+def _jit_static_donate(call: Optional[ast.Call], fn: ast.AST):
+    """(static param names, donate_argnums tuple) from a jit call's kwargs.
+    Only literal tuples/ints are resolved — dynamic specs are rare and a
+    rule that guessed wrong would lie."""
+    static: set = set()
+    donate: tuple = ()
+    if call is None:
+        return frozenset(), ()
+    params = []
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        args = fn.args
+        params = ([a.arg for a in args.posonlyargs]
+                  + [a.arg for a in args.args])
+    for kw in call.keywords:
+        val = kw.value
+        items: list = []
+        if isinstance(val, (ast.Tuple, ast.List)):
+            items = [e.value for e in val.elts if isinstance(e, ast.Constant)]
+        elif isinstance(val, ast.Constant):
+            items = [val.value]
+        if kw.arg == "static_argnums":
+            static.update(params[i] for i in items
+                          if isinstance(i, int) and i < len(params))
+        elif kw.arg == "static_argnames":
+            static.update(s for s in items if isinstance(s, str))
+        elif kw.arg == "donate_argnums":
+            donate = tuple(i for i in items if isinstance(i, int))
+    return frozenset(static), donate
+
+
+class LintModule:
+    """One parsed source file plus lazily computed shared analyses."""
+
+    def __init__(self, path: Path, source: Optional[str] = None,
+                 root: Optional[Path] = None):
+        self.path = Path(path)
+        self.source = (self.path.read_text() if source is None else source)
+        self.tree = ast.parse(self.source, filename=str(path))
+        try:
+            self.relpath = str(self.path.resolve().relative_to(
+                Path(root).resolve())) if root else str(path)
+        except ValueError:
+            self.relpath = str(path)
+        self._parents: dict = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    # -- generic structure ---------------------------------------------------
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    @cached_property
+    def functions(self) -> list:
+        return [n for n in ast.walk(self.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    @cached_property
+    def classes(self) -> list:
+        return [n for n in ast.walk(self.tree) if isinstance(n, ast.ClassDef)]
+
+    def inside_phase_block(self, node: ast.AST, phase: str) -> bool:
+        """Is ``node`` lexically inside ``with <anything>("<phase>")``?
+        The engine brackets every sanctioned device->host sync in
+        ``with ph("device_fetch")`` — the phase attribution that makes the
+        sync visible in /metrics is exactly what makes it sanctioned."""
+        for anc in self.ancestors(node):
+            if not isinstance(anc, ast.With):
+                continue
+            for item in anc.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call) and any(
+                        isinstance(a, ast.Constant) and a.value == phase
+                        for a in expr.args):
+                    return True
+        return False
+
+    # -- jit analysis --------------------------------------------------------
+
+    @cached_property
+    def jitted_functions(self) -> list:
+        """Every function the module compiles: decorated defs plus defs and
+        lambdas handed to ``jax.jit`` / ``*._maybe_jit`` as first arg."""
+        out: list = []
+        defs_by_scope: dict = {}
+        for fn in self.functions:
+            scope = self.enclosing_function(fn)
+            defs_by_scope.setdefault(scope, {})[fn.name] = fn
+        for fn in self.functions:
+            for dec in fn.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if is_jit_wrapper(target) or (
+                        isinstance(dec, ast.Call) and is_jit_wrapper(dec)):
+                    call = dec if isinstance(dec, ast.Call) else None
+                    # partial(jax.jit, static_argnums=...) carries kwargs.
+                    static, donate = _jit_static_donate(call, fn)
+                    out.append(JittedFn(fn, call, static, donate))
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call) and is_jit_wrapper(node.func)
+                    and node.args):
+                continue
+            first = node.args[0]
+            target = None
+            if isinstance(first, ast.Lambda):
+                target = first
+            elif isinstance(first, ast.Name):
+                scope = self.enclosing_function(node)
+                target = defs_by_scope.get(scope, {}).get(first.id)
+            if target is not None:
+                static, donate = _jit_static_donate(node, target)
+                out.append(JittedFn(target, node, static, donate))
+        return out
+
+    # -- hot-path analysis ---------------------------------------------------
+
+    @cached_property
+    def hot_path_functions(self) -> list:
+        """Methods reachable from an Engine class's step entry points via
+        direct ``self.<method>()`` calls — the per-token serving hot path.
+        Scope: classes whose name contains "Engine" with a ``step``/``_step*``
+        method; reachability is intra-class (cross-module hops land in that
+        module's own lint run)."""
+        out: list = []
+        for cls in self.classes:
+            if "Engine" not in cls.name:
+                continue
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            roots = [name for name in methods
+                     if name == "step" or name.startswith("_step")]
+            if not roots:
+                continue
+            seen: set = set()
+            frontier = list(roots)
+            while frontier:
+                name = frontier.pop()
+                if name in seen or name not in methods:
+                    continue
+                seen.add(name)
+                for node in ast.walk(methods[name]):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id == "self"):
+                        frontier.append(node.func.attr)
+            out.extend(methods[n] for n in sorted(seen))
+        return out
+
+    @cached_property
+    def donated_attr_map(self) -> dict:
+        """``self.<attr>`` -> donate_argnums for compiled-step attributes:
+        resolves both ``self._x_fn = self._maybe_jit(f, donate_argnums=…)``
+        and the builder indirection ``self._x_fn = self._build_y()`` where
+        ``_build_y`` returns a jit-wrapper call (union over its returns)."""
+        out: dict = {}
+        for cls in self.classes:
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, ast.FunctionDef)}
+
+            def donate_of(expr) -> tuple:
+                if isinstance(expr, ast.Call):
+                    if is_jit_wrapper(expr.func):
+                        _, d = _jit_static_donate(expr, ast.Lambda(
+                            args=ast.arguments(posonlyargs=[], args=[],
+                                               kwonlyargs=[], kw_defaults=[],
+                                               defaults=[]),
+                            body=ast.Constant(None)))
+                        return d
+                    callee = expr.func
+                    if (isinstance(callee, ast.Attribute)
+                            and isinstance(callee.value, ast.Name)
+                            and callee.value.id == "self"
+                            and callee.attr in methods):
+                        donated: set = set()
+                        for node in ast.walk(methods[callee.attr]):
+                            if (isinstance(node, ast.Return)
+                                    and node.value is not None):
+                                donated.update(donate_of(node.value))
+                        return tuple(sorted(donated))
+                return ()
+
+            for method in methods.values():
+                for node in ast.walk(method):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for tgt in node.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            d = donate_of(node.value)
+                            if d:
+                                out[tgt.attr] = tuple(
+                                    sorted(set(out.get(tgt.attr, ())) | set(d)))
+        return out
+
+
+class Rule:
+    """Base class: one invariant, checked per module. Subclasses set
+    ``code``/``name``/``description`` and implement :meth:`check`."""
+
+    code: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mod: LintModule, node: ast.AST, message: str) -> Finding:
+        return Finding(rule=self.code, name=self.name, path=mod.relpath,
+                       line=getattr(node, "lineno", 0), message=message)
+
+
+# -- taint propagation (shared by trace-safety) -------------------------------
+
+def tainted_refs(expr: ast.AST, tainted: set) -> list:
+    """Names in ``expr`` that carry traced values, EXCLUDING references that
+    resolve to trace-time-static data (``.shape``/``.dtype``/…, ``len()``)."""
+    hits: list = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+            return                      # x.shape is static — don't descend
+        if isinstance(node, ast.Call):
+            callee = node.func
+            name = (callee.id if isinstance(callee, ast.Name)
+                    else getattr(callee, "attr", ""))
+            if name in STATIC_CALLS:
+                return                  # len(x) is static under jit
+        if isinstance(node, ast.Name) and node.id in tainted:
+            hits.append(node.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(expr)
+    return hits
+
+
+def propagate_taint(fn: ast.AST, seeds: Iterable[str]) -> set:
+    """Fixpoint over simple assignments: a name assigned from a tainted
+    expression becomes tainted (one function's scope, nested defs included
+    — the scan/cond bodies live there)."""
+    tainted = set(seeds)
+    for _ in range(10):
+        grew = False
+        for node in ast.walk(fn):
+            value = targets = None
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                value, targets = node.value, [node.target]
+            elif isinstance(node, ast.NamedExpr):
+                value, targets = node.value, [node.target]
+            elif isinstance(node, ast.For):
+                value, targets = node.iter, [node.target]
+            if value is None or not tainted_refs(value, tainted):
+                continue
+            for tgt in targets:
+                for leaf in ast.walk(tgt):
+                    if (isinstance(leaf, ast.Name)
+                            and leaf.id not in tainted):
+                        tainted.add(leaf.id)
+                        grew = True
+        if not grew:
+            break
+    return tainted
+
+
+# -- runner -------------------------------------------------------------------
+
+def iter_py_files(paths: Iterable) -> list:
+    files: list = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(f for f in p.rglob("*.py")
+                                if "__pycache__" not in f.parts))
+        elif p.suffix == ".py":
+            files.append(p)
+    return files
+
+
+def run_lint(paths: Iterable, rules: Optional[list] = None,
+             root: Optional[Path] = None) -> list:
+    """Run ``rules`` (default: all registered) over every .py under
+    ``paths``; returns findings sorted by location. A syntactically broken
+    file is itself a finding — the linter must never silently skip."""
+    from .rules import ALL_RULES
+    rules = list(ALL_RULES) if rules is None else list(rules)
+    findings: list = []
+    for path in iter_py_files(paths):
+        try:
+            mod = LintModule(path, root=root)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="KGCT000", name="parse-error", path=str(path),
+                line=e.lineno or 0, message=f"cannot parse: {e.msg}"))
+            continue
+        for rule in rules:
+            findings.extend(rule.check(mod))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

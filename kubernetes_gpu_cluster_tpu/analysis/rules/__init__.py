@@ -1,0 +1,42 @@
+"""kgct-lint rule registry.
+
+Each module owns one invariant class; every rule here runs in the tier-1
+empty-baseline test (tests/test_lint_clean.py) — adding a rule means the
+whole package must already satisfy it.
+"""
+
+from .trace_safety import TraceSafetyRule
+from .host_sync import HostSyncRule
+from .recompile import RecompileRiskRule
+from .donation import DonationSafetyRule
+from .kv_commit import KVCommitSafetyRule
+from .asyncio_hygiene import AsyncioHygieneRule
+from .metric_hygiene import MetricHygieneRule
+from .logging_hygiene import LoggingHygieneRule
+
+ALL_RULES = [
+    TraceSafetyRule(),
+    HostSyncRule(),
+    RecompileRiskRule(),
+    DonationSafetyRule(),
+    KVCommitSafetyRule(),
+    AsyncioHygieneRule(),
+    MetricHygieneRule(),
+    LoggingHygieneRule(),
+]
+
+
+def rules_by_code(codes) -> list:
+    """Resolve a --select list (codes or names, case-insensitive)."""
+    wanted = {c.strip().upper() for c in codes if c.strip()}
+    out = [r for r in ALL_RULES
+           if r.code.upper() in wanted or r.name.upper() in wanted]
+    known = {r.code.upper() for r in ALL_RULES} | {r.name.upper()
+                                                  for r in ALL_RULES}
+    unknown = wanted - known
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+    return out
+
+
+__all__ = ["ALL_RULES", "rules_by_code"]

@@ -1,0 +1,117 @@
+"""KGCT004 donation-safety: never read a donated buffer after dispatch.
+
+The KV pool (and the sampled-decode counts histogram) ride every step
+donated — XLA aliases the output into the input buffer, so the Python
+reference passed in is DEAD the moment the call returns. Reading it again
+returns garbage-or-crash depending on backend ("dispatch succeeded, decode
+output wrong" — the worst failure class). The safe idiom, used everywhere
+in the engine, rebinds the donated slot from the call's own result in the
+same statement::
+
+    (..., self.kv_cache) = self._decode_fn(params, self.kv_cache, ...)
+
+This rule resolves each compiled step attribute's ``donate_argnums``
+(through the ``_build_*`` indirection) and flags any later read of the
+donated argument expression before it is rebound.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import Finding, LintModule, Rule
+
+
+def _expr_key(node: ast.AST) -> Optional[str]:
+    """Stable identity for rebind/read matching; None for expressions we
+    cannot track (calls, subscripts — conservatively skipped)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _expr_key(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+class DonationSafetyRule(Rule):
+    code = "KGCT004"
+    name = "donation-safety"
+    description = ("argument passed at a donate_argnums position read "
+                   "again after the dispatch call without being rebound")
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        donated_map = mod.donated_attr_map
+        if not donated_map:
+            return
+        for fn in mod.functions:
+            yield from self._check_function(mod, fn, donated_map)
+
+    def _check_function(self, mod: LintModule, fn, donated_map):
+        # statement-level scan in source order
+        stmts = [n for n in ast.walk(fn)
+                 if isinstance(n, ast.stmt) and n is not fn]
+        stmts.sort(key=lambda n: (n.lineno, n.col_offset))
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr in donated_map):
+                continue
+            rebound = self._assign_targets_of_call(mod, node)
+            for pos in donated_map[node.func.attr]:
+                if pos >= len(node.args):
+                    continue
+                key = _expr_key(node.args[pos])
+                if key is None or key in ("None",):
+                    continue
+                if key in rebound:
+                    continue          # rebound from the call's own result
+                hit = self._read_after(fn, node, key)
+                if hit is not None:
+                    yield self.finding(
+                        mod, hit,
+                        f"donated buffer {key!r} (arg {pos} of "
+                        f"self.{node.func.attr}) read after dispatch at "
+                        f"line {node.lineno} without rebinding — XLA "
+                        "aliased it away; rebind it from the call result")
+
+    def _assign_targets_of_call(self, mod: LintModule, call: ast.Call) -> set:
+        """Expression keys assigned by the statement containing ``call``
+        (tuple targets flattened)."""
+        stmt = call
+        for anc in mod.ancestors(call):
+            if isinstance(anc, ast.stmt):
+                stmt = anc
+                break
+        keys: set = set()
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                parts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                    else [tgt]
+                for part in parts:
+                    k = _expr_key(part)
+                    if k:
+                        keys.add(k)
+        return keys
+
+    def _read_after(self, fn, call: ast.Call, key: str):
+        """First Load of ``key`` after the call line, unless a Store to it
+        intervenes. Lexical order approximates execution order — the same
+        approximation the engine's straight-line dispatch code satisfies."""
+        events = []
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Name, ast.Attribute)) \
+                    and _expr_key(node) == key:
+                ctx = getattr(node, "ctx", None)
+                events.append((node.lineno, node.col_offset,
+                               isinstance(ctx, ast.Store), node))
+        events.sort()
+        for lineno, col, is_store, node in events:
+            if lineno <= call.end_lineno:
+                continue
+            if is_store:
+                return None
+            return node
+        return None

@@ -1,0 +1,79 @@
+"""KGCT003 recompile-risk: bounded compile-variant families only.
+
+Two ways serving code silently grows the jit cache without bound:
+
+1. Wrapping a FRESH callable per call — ``jax.jit(lambda …)`` inside a
+   loop or a hot-path method compiles every time (cache keys on callable
+   identity). Builders that run once at engine construction are fine.
+2. Feeding a compiled step program an array whose shape derives from a
+   per-request Python value (``len(seqs)``, …) without passing it through
+   a bucketing helper — one compile per distinct request shape, exactly
+   the variant explosion tests/test_compile_guard.py bounds.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..core import Finding, LintModule, Rule, is_jit_wrapper
+
+_STEP_FN_ATTR = re.compile(r"^_\w+_fn$")
+# Call names that quantize a per-request value onto the compile-shape grid.
+_BUCKETING = re.compile(r"bucket|next_power_of_2", re.I)
+
+
+class RecompileRiskRule(Rule):
+    code = "KGCT003"
+    name = "recompile-risk"
+    description = ("jit of a fresh callable in loops/hot paths, or jitted "
+                   "call args shaped by unbucketed per-request len()")
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        hot = set(mod.hot_path_functions)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # (1) fresh-callable jit in a loop or hot-path function
+            if is_jit_wrapper(node.func) and node.args:
+                in_loop = any(isinstance(a, (ast.For, ast.While))
+                              for a in mod.ancestors(node))
+                fn = mod.enclosing_function(node)
+                if in_loop or fn in hot:
+                    where = ("a loop" if in_loop
+                             else f"hot-path {fn.name!r}")
+                    yield self.finding(
+                        mod, node,
+                        f"jit wrapper called in {where}: compiles a fresh "
+                        "program per call (cache keys on callable identity);"
+                        " build the jitted fn once at init")
+                continue
+            # (2) unbucketed len() shaping a compiled program's inputs
+            callee = node.func
+            is_step_call = (
+                (isinstance(callee, ast.Attribute)
+                 and isinstance(callee.value, ast.Name)
+                 and callee.value.id == "self"
+                 and _STEP_FN_ATTR.match(callee.attr)))
+            if not is_step_call:
+                continue
+            for arg in node.args:
+                for sub in ast.walk(arg):
+                    if not (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Name)
+                            and sub.func.id == "len"):
+                        continue
+                    bucketed = any(
+                        isinstance(anc, ast.Call)
+                        and _BUCKETING.search(
+                            getattr(anc.func, "id",
+                                    getattr(anc.func, "attr", "")) or "")
+                        for anc in mod.ancestors(sub))
+                    if not bucketed:
+                        yield self.finding(
+                            mod, sub,
+                            "compiled step program fed a shape derived from "
+                            "per-request len() with no bucketing — one XLA "
+                            "compile per distinct request shape; route it "
+                            "through the bucket grid")

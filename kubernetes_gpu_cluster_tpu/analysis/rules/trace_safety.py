@@ -1,0 +1,57 @@
+"""KGCT001 trace-safety: no Python control flow on traced values.
+
+Inside a jitted function, ``if``/``while``/``assert``/``bool()`` on a value
+derived from a traced argument forces concretization at trace time — at
+best a silent recompile per branch outcome, at worst a
+``ConcretizationTypeError`` deep in serving. Branching on trace-time-static
+data (``x.shape``, ``len(x)``, closure config) is fine and stays silent;
+the engine's step programs route runtime decisions through ``lax.cond`` /
+``jnp.where`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, LintModule, Rule, propagate_taint, tainted_refs
+
+
+class TraceSafetyRule(Rule):
+    code = "KGCT001"
+    name = "trace-safety"
+    description = ("Python if/while/assert/bool() on values derived from a "
+                   "jitted function's traced arguments")
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        for jf in mod.jitted_functions:
+            fn = jf.node
+            if isinstance(fn, ast.Lambda):
+                body = fn.body
+            else:
+                body = fn
+            seeds = set(jf.params) - set(jf.static_names)
+            tainted = propagate_taint(fn, seeds)
+            for node in ast.walk(body):
+                test = None
+                kind = None
+                if isinstance(node, (ast.If, ast.While)):
+                    test, kind = node.test, type(node).__name__.lower()
+                elif isinstance(node, ast.IfExp):
+                    test, kind = node.test, "conditional expression"
+                elif isinstance(node, ast.Assert):
+                    test, kind = node.test, "assert"
+                elif (isinstance(node, ast.Call)
+                      and isinstance(node.func, ast.Name)
+                      and node.func.id == "bool" and node.args):
+                    test, kind = node.args[0], "bool()"
+                if test is None:
+                    continue
+                refs = tainted_refs(test, tainted)
+                if refs:
+                    yield self.finding(
+                        mod, node,
+                        f"Python {kind} on traced value(s) "
+                        f"{sorted(set(refs))} inside jitted "
+                        f"{getattr(fn, 'name', '<lambda>')!r}; use lax.cond/"
+                        "jnp.where (or declare the arg static)")

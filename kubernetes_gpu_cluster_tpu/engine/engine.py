@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.sanitize import build_step_sanitizer
 from ..config import EngineConfig
 from ..models import llama as model_lib
 from ..observability import Observability
@@ -272,6 +273,11 @@ class LLMEngine:
         self._counts_pool: dict[int, Any] = {}
         self._dummy_out: dict[int, Any] = {}
         self._dummy_bias: dict[int, Any] = {}
+        # Runtime sanitizers (KGCT_SANITIZE=1, analysis/sanitize.py):
+        # step-output NaN/vocab guard + KV-slot shadow for the spec-decode
+        # rollback contract. None when off — every hook is one is-None
+        # test and outputs are byte-identical with the sanitizer absent.
+        self._sanitizer = build_step_sanitizer(config.cache.page_size)
 
     def _resolve_use_pallas(self, use_pallas: Optional[bool]) -> bool:
         """Decide the kernel path ONCE, at init, from static facts — backend,
@@ -1131,6 +1137,13 @@ class LLMEngine:
         ph = self.obs.phases.phase
         R_pad = batch.page_tables.shape[0]
         S = len(batch.tokens) // R_pad
+        # Chaos site: KGCT_FAULT=kv_commit_stomp corrupts one KV write slot
+        # BEFORE the upload, so the device really would stomp committed
+        # history — the KV shadow (KGCT_SANITIZE=1) must catch it here.
+        if _inject_fault("kv_commit_stomp"):
+            _stomp_committed_slot(batch, self.config.cache.page_size, S)
+        if self._sanitizer is not None:
+            self._sanitizer.on_spec_dispatch(batch)
         with ph("host_prep"):
             int_t = jnp.asarray(np.stack(
                 [batch.tokens, batch.seg_ids, batch.positions,
@@ -1166,6 +1179,10 @@ class LLMEngine:
         drafted = int(draft_lens.sum())
         accepted = int(np.minimum(n_acc_np[:B], draft_lens).sum())
         greedy = bool(np.all(batch.temperature[:B] <= 0))
+        if self._sanitizer is not None:
+            # Before _process_window appends tokens: rejected-draft slots
+            # (past each row's accepted prefix) become stale in the shadow.
+            self._sanitizer.on_spec_commit(batch, emit)
         with ph("postproc"):
             outs = self._process_window(batch, toks_np, lps_np, set(),
                                         defer=False, top_ids=top_i,
@@ -1216,6 +1233,9 @@ class LLMEngine:
                          positions: np.ndarray, float_b,
                          counts=None) -> dict:
         ph = self.obs.phases.phase
+        if self._sanitizer is not None:
+            self._sanitizer.on_decode_dispatch(
+                batch.seqs, positions, self.config.scheduler.decode_window)
         with ph("host_prep"):
             int_b = jnp.asarray(np.concatenate(
                 [np.stack([positions, batch.top_k, batch.seed, batch.top_n],
@@ -1311,6 +1331,15 @@ class LLMEngine:
         ``emit_counts`` [B_pad] caps the usable columns per row (spec steps:
         accepted drafts + 1; slots past the first rejection are garbage).
         """
+        # Chaos site: KGCT_FAULT=nan_step_output poisons the fetched
+        # logprobs — the corruption class the KGCT_SANITIZE step-output
+        # guard must catch before any client sees it.
+        if _inject_fault("nan_step_output"):
+            logprobs = np.full_like(np.asarray(logprobs, np.float32), np.nan)
+        if self._sanitizer is not None:
+            self._sanitizer.check_outputs(
+                next_tokens, logprobs, emit_counts,
+                self.model_config.vocab_size, len(batch.seqs))
         outputs = []
         for s, seq in enumerate(batch.seqs):
             if seq.request_id in zombies:
@@ -1431,6 +1460,21 @@ class LLMEngine:
                 if out.finished:
                     final[out.request_id] = out
         return [final[f"req-{i}"] for i in range(len(prompts))]
+
+
+def _stomp_committed_slot(batch, page_size: int, S: int) -> None:
+    """Chaos helper (``KGCT_FAULT=kv_commit_stomp``): redirect row 0's
+    first draft KV write to the sequence's position-0 slot — a REAL write
+    into committed history (``num_tokens - 1 > 0`` guarantees position 0
+    is committed). The KGCT_SANITIZE KV shadow must refuse the dispatch;
+    with the sanitizer off this genuinely corrupts context, which is the
+    point — the harness validates the detector, not a simulation of it."""
+    if not batch.seqs:
+        return
+    seq = batch.seqs[0]
+    if seq.num_tokens < 2 or not seq.pages:
+        return
+    batch.slot_mapping[1 if S > 1 else 0] = seq.pages[0] * page_size
 
 
 def _device_free_memory() -> Optional[int]:

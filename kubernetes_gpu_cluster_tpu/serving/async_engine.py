@@ -67,7 +67,11 @@ class AsyncLLMEngine:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self, loop: Optional[asyncio.AbstractEventLoop] = None) -> None:
-        self._loop = loop or asyncio.get_event_loop()
+        # get_running_loop, not get_event_loop: the fan-out posts chunks
+        # via call_soon_threadsafe, and a loop silently CREATED here (off
+        # the server's thread, never run) would swallow them forever —
+        # kgct-lint KGCT006 pins this.
+        self._loop = loop if loop is not None else asyncio.get_running_loop()
         self._thread.start()
 
     def shutdown(self) -> None:

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Pre-image quality gate: kgct-lint (empty-findings baseline, no allowlist)
+# then the tier-1 test suite. docker/build.sh runs this before building, so
+# an image can never ship lint-dirty or test-broken code; run it standalone
+# before any push for the same signal.
+#
+# Usage: scripts/check.sh [--lint-only]
+#   --lint-only    skip the tier-1 pytest run (seconds instead of minutes;
+#                  the lint gate alone still blocks every rule violation)
+#
+# Exit codes: 0 clean; non-zero on the first failing stage (pipefail —
+# a tee'd pytest failure cannot launder its exit status).
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "${REPO_ROOT}"
+LINT_ONLY=0
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --lint-only) LINT_ONLY=1; shift ;;
+    *) echo "unknown arg: $1" >&2; exit 2 ;;
+  esac
+done
+
+echo ">> kgct-lint (empty-baseline gate)"
+python -m kubernetes_gpu_cluster_tpu.analysis.cli kubernetes_gpu_cluster_tpu bench.py
+
+if [[ "${LINT_ONLY}" == 1 ]]; then
+  echo ">> check.sh: lint clean (tier-1 skipped via --lint-only)"
+  exit 0
+fi
+
+echo ">> tier-1 tests"
+rm -f /tmp/_kgct_check.log
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider \
+  2>&1 | tee /tmp/_kgct_check.log
+rc=${PIPESTATUS[0]}
+echo ">> check.sh: lint clean, tier-1 rc=${rc}"
+exit "${rc}"

@@ -37,62 +37,82 @@ assert jax.device_count() == 8, f"expected 8 virtual CPU devices, got {jax.devic
 
 _HAVE_JAX_SHARD_MAP = hasattr(jax, "shard_map")
 
-# (file, test name) -> why this env cannot run it. Names are matched on the
-# unparametrized test function name.
-_ENV_GATED = {}
-if not _HAVE_JAX_SHARD_MAP:
-    _shard_map_reason = (
+
+def _build_env_gates(have_shard_map: bool) -> dict:
+    """(file, test name) -> why this env cannot run it, keyed on the
+    unparametrized test function name. A capable env (top-level
+    ``jax.shard_map`` present) gates NOTHING — everything runs. Factored
+    out so tests/test_conftest_gate.py can pin the gate table and the
+    per-class reasons independent of the env actually running the suite."""
+    if have_shard_map:
+        return {}
+    shard_map_reason = (
         "env gap: this jax (%s) has no top-level jax.shard_map (the tp/pp/ep "
         "wrappers call it); pre-existing since the seed" % jax.__version__)
-    _interpret_reason = (
+    interpret_reason = (
         "env gap: this jax (%s) lacks Pallas interpret-mode state-discharge "
         "rules (kernel raises NotImplementedError on CPU); pre-existing "
         "since the seed" % jax.__version__)
-    _multiproc_reason = (
+    multiproc_reason = (
         "env gap: this jaxlib (%s) has no CPU multiprocess collectives "
         "('Multiprocess computations aren't implemented on the CPU "
         "backend'); pre-existing since the seed" % jax.__version__)
+    gated = {}
     for _file, _name, _why in [
-        ("test_distributed.py", "test_two_process_jax_distributed", _multiproc_reason),
-        ("test_distributed.py", "test_two_process_full_engine", _multiproc_reason),
-        ("test_distributed.py", "test_two_process_serving_leader_follower", _multiproc_reason),
-        ("test_pallas.py", "test_stacked_pool_layer_index", _interpret_reason),
-        ("test_pallas.py", "test_paged_decode_tp_matches_oracle", _shard_map_reason),
-        ("test_pallas.py", "test_flash_prefill_tp_matches_oracle", _shard_map_reason),
-        ("test_pallas.py", "test_engine_decode_via_attn_mesh", _shard_map_reason),
-        ("test_pallas.py", "test_prefill_history_tp_matches_oracle", _shard_map_reason),
-        ("test_parallel.py", "test_pp_engine_matches_single_device", _shard_map_reason),
-        ("test_parallel.py", "test_pp_only_mesh_matches_single_device", _shard_map_reason),
-        ("test_parallel.py", "test_pp_engine_chunked_prefill", _shard_map_reason),
-        ("test_parallel.py", "test_moe_block_shard_map_matches_dense", _shard_map_reason),
-        ("test_parallel.py", "test_pp_prefill_matches_single_device", _shard_map_reason),
-        ("test_parallel.py", "test_pp_decode_matches_single_device", _shard_map_reason),
-        ("test_parallel.py", "test_north_star_70b_tp_pp_traces", _shard_map_reason),
-        ("test_parallel.py", "test_pp_hist_no_layer_stack_gather", _shard_map_reason),
+        ("test_distributed.py", "test_two_process_jax_distributed", multiproc_reason),
+        ("test_distributed.py", "test_two_process_full_engine", multiproc_reason),
+        ("test_distributed.py", "test_two_process_serving_leader_follower", multiproc_reason),
+        ("test_pallas.py", "test_stacked_pool_layer_index", interpret_reason),
+        ("test_pallas.py", "test_paged_decode_tp_matches_oracle", shard_map_reason),
+        ("test_pallas.py", "test_flash_prefill_tp_matches_oracle", shard_map_reason),
+        ("test_pallas.py", "test_engine_decode_via_attn_mesh", shard_map_reason),
+        ("test_pallas.py", "test_prefill_history_tp_matches_oracle", shard_map_reason),
+        ("test_parallel.py", "test_pp_engine_matches_single_device", shard_map_reason),
+        ("test_parallel.py", "test_pp_only_mesh_matches_single_device", shard_map_reason),
+        ("test_parallel.py", "test_pp_engine_chunked_prefill", shard_map_reason),
+        ("test_parallel.py", "test_moe_block_shard_map_matches_dense", shard_map_reason),
+        ("test_parallel.py", "test_pp_prefill_matches_single_device", shard_map_reason),
+        ("test_parallel.py", "test_pp_decode_matches_single_device", shard_map_reason),
+        ("test_parallel.py", "test_north_star_70b_tp_pp_traces", shard_map_reason),
+        ("test_parallel.py", "test_pp_hist_no_layer_stack_gather", shard_map_reason),
     ]:
-        _ENV_GATED[(_file, _name)] = _why
+        gated[(_file, _name)] = _why
     # TestPagedDecodeKernel::test_matches_xla shares a name with other
     # classes' interpret-mode tests that DO pass; key the gated one by its
     # class too.
-    _ENV_GATED[("test_pallas.py", "TestPagedDecodeKernel.test_matches_xla")] = \
-        _interpret_reason
+    gated[("test_pallas.py", "TestPagedDecodeKernel.test_matches_xla")] = \
+        interpret_reason
+    return gated
 
 
-def pytest_collection_modifyitems(config, items):
-    if not _ENV_GATED:
-        return
+_ENV_GATED = _build_env_gates(_HAVE_JAX_SHARD_MAP)
+
+
+def _apply_env_gates(items, gates) -> list:
+    """Add skip markers to exactly the gated items; returns the (item,
+    reason) pairs applied. Anything NOT in the gate table is left alone —
+    a new failure must FAIL, the gates exist to keep known env gaps from
+    burying it in noise (tests/test_conftest_gate.py pins both sides)."""
     import pytest
 
+    applied = []
     for item in items:
         fname = item.path.name if hasattr(item, "path") else item.fspath.basename
         name = item.originalname if getattr(item, "originalname", None) else item.name
         cls = item.cls.__name__ + "." if getattr(item, "cls", None) else ""
         # Class-qualified key wins (disambiguates test_matches_xla, which
         # exists in several kernel classes and only one is env-gated).
-        why = (_ENV_GATED.get((fname, cls + name))
-               or _ENV_GATED.get((fname, name)))
+        why = gates.get((fname, cls + name)) or gates.get((fname, name))
         if why:
             item.add_marker(pytest.mark.skip(reason=why))
+            applied.append((item, why))
+    return applied
+
+
+def pytest_collection_modifyitems(config, items):
+    if not _ENV_GATED:
+        return
+    _apply_env_gates(items, _ENV_GATED)
 
 # -- per-test timeout fallback ----------------------------------------------
 # pytest-timeout (wired via pyproject [tool.pytest.ini_options]) is the real

@@ -12,10 +12,13 @@ of yielding null.
 
 import json
 import math
+from pathlib import Path
 
 import pytest
 
 import bench
+
+REPO = Path(__file__).resolve().parent.parent
 
 
 def _fake_results():
@@ -71,6 +74,99 @@ class TestTranscriptParsing:
     def test_non_object_result_raises(self):
         with pytest.raises(ValueError, match="expected a JSON object"):
             bench.parse_result_line("[1, 2, 3]\n")
+
+
+class TestDriverRecordGuard:
+    """The official-record failure modes, pinned against REAL driver
+    captures: BENCH_r04.json parsed fine (779-char tail, noisy WARNING/
+    INFO preamble); BENCH_r05.json landed "parsed": null because its
+    result line outgrew the driver's 2000-char tail window and the capture
+    DECAPITATED it. emit_result now bounds the line (RESULT_LINE_MAX) so a
+    tail capture can never cut the head off again."""
+
+    def _real_record(self, name):
+        rec = json.loads((REPO / name).read_text())
+        assert {"tail", "parsed"} <= set(rec)
+        return rec
+
+    def test_real_r04_noisy_transcript_round_trips(self):
+        """A genuine driver capture — jax platform warnings, engine INFO
+        lines, then the result — must parse to exactly what the driver
+        recorded."""
+        rec = self._real_record("BENCH_r04.json")
+        parsed = bench.parse_result_line(rec["tail"])
+        assert parsed == rec["parsed"]
+        assert parsed["unit"] == "tokens/s/chip"
+
+    def test_real_r05_decapitated_tail_raises_not_null(self):
+        """The r5 failure mode itself: the tail window cut the head off an
+        oversized result line. parse_result_line must RAISE (the driver
+        records the error) — a silent null is how r5's numbers vanished."""
+        rec = self._real_record("BENCH_r05.json")
+        assert rec["parsed"] is None   # the incident this guard pins
+        with pytest.raises(ValueError, match="not the bench result JSON"):
+            bench.parse_result_line(rec["tail"])
+
+    def _oversized_result(self):
+        # r05-scale: many configs, each carrying the nested bench blocks
+        configs = [dict(_fake_results()[0],
+                        roofline={"hbm_gbps": 575.7, "mfu": 0.29,
+                                  "chip": {"hbm_gbps_peak": 819.0}},
+                        sustained_load={"ttft_p50_ms": 3436.8,
+                                        "ttft_p95_ms": 6331.1},
+                        speculative={"spec": {"acceptance_ratio": 0.8}},
+                        trial=i)
+                   for i in range(8)]
+        return bench.assemble_output(configs, "tpu")
+
+    def test_oversized_result_survives_a_2000_char_tail(self, capsys):
+        out = self._oversized_result()
+        assert len(json.dumps(out)) > 2000   # genuinely r05-sized
+        print("warmup noise " * 40)
+        bench.emit_result(out)
+        captured = capsys.readouterr()
+        tail = captured.out[-2000:]          # the driver's capture window
+        parsed = bench.parse_result_line(tail)
+        assert parsed["value"] == out["value"]
+        assert parsed["metric"] == out["metric"]
+        assert parsed["configs_on_stderr"] is True
+        # nothing lost: the full result rides stderr
+        full_lines = [ln for ln in captured.err.splitlines()
+                      if ln.startswith("FULL_RESULT: ")]
+        assert len(full_lines) == 1
+        assert json.loads(full_lines[0][len("FULL_RESULT: "):]) == out
+
+    def test_result_line_always_bounded(self, capsys):
+        bench.emit_result(self._oversized_result())
+        last = capsys.readouterr().out.splitlines()[-1]
+        assert len(last) <= bench.RESULT_LINE_MAX < 2000
+
+    def test_headline_bloat_degrades_but_never_fails(self, capsys):
+        """Even when a headline block itself outgrows the bound (so
+        dropping configs isn't enough), emit_result degrades block by
+        block — the primary metric/value/unit always land on stdout,
+        bounded. It must never raise or emit an unbounded line."""
+        out = self._oversized_result()
+        out["ttft_decomposition"] = {f"k{i}": float(i) for i in range(400)}
+        bench.emit_result(out)
+        last = capsys.readouterr().out.splitlines()[-1]
+        assert len(last) <= bench.RESULT_LINE_MAX
+        parsed = json.loads(last)
+        assert parsed["metric"] == out["metric"]
+        assert parsed["value"] == out["value"]
+        assert "ttft_decomposition" not in parsed
+
+    def test_small_result_passes_through_unshrunk(self, capsys):
+        out = bench.assemble_output(_fake_results(), "cpu")
+        assert bench.compact_result(out) is out
+        bench.emit_result(out)
+        parsed = bench.parse_result_line(capsys.readouterr().out)
+        assert parsed == json.loads(json.dumps(out))
+        assert "configs" in parsed
+
+    def test_help_documents_the_bound(self):
+        text = bench.build_arg_parser().format_help()
+        assert "RESULT_LINE_MAX" in text and "tail" in text.lower()
 
 
 class TestHelpDocumentsContract:

@@ -97,3 +97,36 @@ class TestTagAgreement:
         path = DOCKER / "build.sh"
         assert path.stat().st_mode & 0o111, "build.sh must be executable"
         subprocess.run(["bash", "-n", str(path)], check=True)
+
+
+class TestCheckGate:
+    """Images cannot ship lint-dirty code: docker/build.sh runs
+    scripts/check.sh (kgct-lint empty baseline + tier-1) before any
+    docker build, with an explicit logged escape hatch only."""
+
+    CHECK = REPO / "scripts" / "check.sh"
+
+    def test_build_script_invokes_check_before_building(self):
+        build_sh = (DOCKER / "build.sh").read_text()
+        assert 'scripts/check.sh' in build_sh
+        assert "KGCT_SKIP_CHECKS" in build_sh
+        # the gate must run before the first image build
+        assert build_sh.index("check.sh") < build_sh.index(
+            "tpu-serving Dockerfile.serving")
+
+    def test_check_script_is_executable_bash(self):
+        assert self.CHECK.stat().st_mode & 0o111
+        subprocess.run(["bash", "-n", str(self.CHECK)], check=True)
+
+    def test_check_script_stages_and_pipefail(self):
+        sh = self.CHECK.read_text()
+        assert "set -euo pipefail" in sh
+        # stage 1: the lint gate, same runner as tests/test_lint_clean.py
+        assert "kubernetes_gpu_cluster_tpu.analysis.cli" in sh
+        # stage 2: tier-1, with the tee'd exit status preserved
+        assert "pytest tests/" in sh and "-m 'not slow'" in sh
+        assert "PIPESTATUS" in sh
+
+    def test_check_script_lint_stage_runs_clean(self):
+        subprocess.run(["bash", str(self.CHECK), "--lint-only"],
+                       check=True, cwd=REPO, capture_output=True)

@@ -1,0 +1,82 @@
+"""The tier-1 lint gate: the FULL package is kgct-lint clean, no allowlist.
+
+This is the enforcement half of the static-analysis subsystem: every rule
+in analysis/rules runs over every package module (plus bench.py) and the
+baseline is EMPTY. A hot-path host sync, a trace-unsafe branch, a donated
+buffer read, an unbounded metric label — any regression fails here, in
+tests, instead of shipping as a silent perf/correctness cliff. There is
+deliberately no suppression mechanism: a finding is fixed or the rule is
+wrong (and fixed).
+"""
+
+from pathlib import Path
+
+from kubernetes_gpu_cluster_tpu.analysis import ALL_RULES, run_lint
+from kubernetes_gpu_cluster_tpu.analysis.cli import main as lint_main
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "kubernetes_gpu_cluster_tpu"
+BENCH = REPO / "bench.py"
+
+
+def test_package_is_lint_clean_empty_baseline():
+    findings = run_lint([PACKAGE, BENCH], root=REPO)
+    assert findings == [], (
+        "kgct-lint must stay clean (fix the finding, don't allowlist):\n"
+        + "\n".join(f.format() for f in findings))
+
+
+def test_all_rules_actually_ran_against_real_structures():
+    """Guard against a vacuous pass: the shared analyses must resolve the
+    engine's real jitted programs, hot path and donation map — if a
+    refactor renames the patterns the rules key on, this fails before the
+    empty baseline becomes meaningless."""
+    from kubernetes_gpu_cluster_tpu.analysis.core import LintModule
+    mod = LintModule(PACKAGE / "engine" / "engine.py", root=REPO)
+    jitted = {getattr(j.node, "name", "<lambda>")
+              for j in mod.jitted_functions}
+    assert {"prefill_step", "spec_step", "mixed_step"} <= jitted
+    hot = {f.name for f in mod.hot_path_functions}
+    assert {"step", "_step", "_step_spec", "_dispatch_window",
+            "_process_window"} <= hot
+    donated = mod.donated_attr_map
+    assert donated.get("_prefill_fn") == (1,)
+    assert donated.get("_decode_fn") == (1, 6)
+
+
+def test_cli_clean_run_exits_zero(capsys):
+    rc = lint_main([str(PACKAGE / "analysis")])
+    assert rc == 0
+    assert "0 finding(s)" in capsys.readouterr().err
+
+
+def test_cli_findings_exit_one(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n\nasync def h():\n    time.sleep(1)\n")
+    rc = lint_main([str(bad)])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "KGCT006" in out.out
+
+
+def test_cli_list_rules_shows_all_eight(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ALL_RULES:
+        assert rule.code in out
+    assert len(ALL_RULES) >= 8
+
+
+def test_cli_json_format(tmp_path, capsys):
+    import json
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(logger, a):\n    logger.info(f'{a}')\n")
+    rc = lint_main([str(bad), "--format", "json"])
+    findings = json.loads(capsys.readouterr().out)
+    assert rc == 1 and findings[0]["rule"] == "KGCT008"
+
+
+def test_cli_console_script_is_declared():
+    pyproject = (REPO / "pyproject.toml").read_text()
+    assert ('kgct-lint = "kubernetes_gpu_cluster_tpu.analysis.cli:main"'
+            in pyproject)

@@ -1,0 +1,375 @@
+"""Per-rule positive/negative pins for the kgct-lint rule suite.
+
+Every rule must (a) fire on a minimal violating snippet — the regression
+the rule exists to catch — and (b) stay silent on the idiomatic-correct
+form the engine actually uses. The empty-baseline run over the real
+package is tests/test_lint_clean.py; these are the rule semantics.
+"""
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from kubernetes_gpu_cluster_tpu.analysis.core import LintModule, run_lint
+from kubernetes_gpu_cluster_tpu.analysis.rules import ALL_RULES, rules_by_code
+
+
+def lint(code: str, rule_code: str, relpath: str = "engine/fake.py"):
+    mod = LintModule(Path(relpath), source=textwrap.dedent(code))
+    [rule] = rules_by_code([rule_code])
+    return list(rule.check(mod))
+
+
+class TestTraceSafety:  # KGCT001
+    def test_python_if_on_traced_arg_fires(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """, "KGCT001")
+        assert len(found) == 1 and "if" in found[0].message
+
+    def test_taint_propagates_through_assignment(self):
+        found = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                y = x + 1
+                while y < 3:
+                    y = y + 1
+                return y
+        """, "KGCT001")
+        assert found and "while" in found[0].message
+
+    def test_builder_maybe_jit_pattern_is_analyzed(self):
+        found = lint("""
+            class FooEngine:
+                def _build_step(self):
+                    def step(params, kv, flags):
+                        return kv if bool(flags) else params
+                    return self._maybe_jit(step, donate_argnums=(1,))
+        """, "KGCT001")
+        # both the conditional expression and the bool() call flag
+        assert found and any("bool()" in f.message for f in found)
+
+    def test_shape_len_and_static_argnames_stay_silent(self):
+        assert lint("""
+            import jax
+
+            def build():
+                def step(x, mode):
+                    n = x.shape[0]
+                    m = n if n % 2 == 0 else 1
+                    if mode == "greedy":
+                        return x.reshape(m, -1)
+                    if len(x) > 4:
+                        return x * 2
+                    return x
+                return jax.jit(step, static_argnames=("mode",))
+        """, "KGCT001") == []
+
+
+class TestHostSync:  # KGCT002
+    def test_item_in_hot_path_fires(self):
+        found = lint("""
+            class FooEngine:
+                def step(self):
+                    out = self._decode_fn(1)
+                    return out.item()
+        """, "KGCT002")
+        assert len(found) == 1 and ".item()" in found[0].message
+
+    def test_reachability_through_self_calls(self):
+        found = lint("""
+            class FooEngine:
+                def _step(self):
+                    return self._helper()
+
+                def _helper(self):
+                    x = self._decode_fn(1)
+                    x.block_until_ready()
+                    return x
+        """, "KGCT002")
+        assert found and "block_until_ready" in found[0].message
+
+    def test_implicit_float_on_step_output_fires(self):
+        found = lint("""
+            class FooEngine:
+                def _step(self):
+                    out = self._decode_fn(1)
+                    return float(out)
+        """, "KGCT002")
+        assert found and "float()" in found[0].message
+
+    def test_device_fetch_window_is_sanctioned(self):
+        assert lint("""
+            class FooEngine:
+                def _step(self):
+                    out = self._decode_fn(1)
+                    with ph("device_fetch"):
+                        out.block_until_ready()
+                    return out
+        """, "KGCT002") == []
+
+    def test_off_hot_path_sync_is_fine(self):
+        # probe/bench code outside step reachability may sync freely
+        assert lint("""
+            class FooEngine:
+                def probe(self):
+                    self._decode_fn(1).block_until_ready()
+        """, "KGCT002") == []
+
+
+class TestRecompileRisk:  # KGCT003
+    def test_jit_in_loop_fires(self):
+        found = lint("""
+            import jax
+
+            def bench(xs):
+                for x in xs:
+                    f = jax.jit(lambda a: a + 1)
+                    f(x)
+        """, "KGCT003")
+        assert found and "loop" in found[0].message
+
+    def test_jit_in_hot_path_fires(self):
+        found = lint("""
+            import jax
+
+            class FooEngine:
+                def _step(self, fn, x):
+                    return jax.jit(fn)(x)
+        """, "KGCT003")
+        assert found and "hot-path" in found[0].message
+
+    def test_unbucketed_len_shape_fires(self):
+        found = lint("""
+            import numpy as np
+
+            class FooEngine:
+                def _step(self, seqs):
+                    return self._decode_fn(np.zeros((len(seqs), 4)))
+        """, "KGCT003")
+        assert found and "bucket" in found[0].message
+
+    def test_bucketed_len_and_init_builders_stay_silent(self):
+        assert lint("""
+            import jax
+            import numpy as np
+
+            class FooEngine:
+                def _build_decode_fn(self):
+                    def step(x):
+                        return x
+                    return jax.jit(step)
+
+                def _step(self, seqs):
+                    B = _bucket(len(seqs), self.buckets)
+                    return self._decode_fn(np.zeros((B, 4)))
+        """, "KGCT003") == []
+
+
+class TestDonationSafety:  # KGCT004
+    def test_read_after_donation_fires(self):
+        found = lint("""
+            import jax
+
+            class FooEngine:
+                def __init__(self, step):
+                    self._step_fn = jax.jit(step, donate_argnums=(1,))
+
+                def run(self, params, kv):
+                    out = self._step_fn(params, kv)
+                    return out, kv.sum()
+        """, "KGCT004")
+        assert len(found) == 1 and "donated buffer 'kv'" in found[0].message
+
+    def test_rebound_in_call_statement_is_safe(self):
+        assert lint("""
+            import jax
+
+            class FooEngine:
+                def __init__(self, step):
+                    self._step_fn = jax.jit(step, donate_argnums=(1,))
+
+                def run(self, params):
+                    out, self.kv = self._step_fn(params, self.kv)
+                    return out, self.kv.sum()
+        """, "KGCT004") == []
+
+    def test_builder_indirection_is_resolved(self):
+        found = lint("""
+            class FooEngine:
+                def __init__(self):
+                    self._step_fn = self._build()
+
+                def _build(self):
+                    def step(params, kv):
+                        return kv
+                    return self._maybe_jit(step, donate_argnums=(1,))
+
+                def run(self, params, kv):
+                    out = self._step_fn(params, kv)
+                    norm = kv.mean()
+                    return out, norm
+        """, "KGCT004")
+        assert found and "read after dispatch" in found[0].message
+
+
+class TestKVCommitSafety:  # KGCT005
+    def test_naked_slot_math_fires(self):
+        found = lint("""
+            def compute_slot(page, ps, pos):
+                return page * ps + pos % ps
+        """, "KGCT005", relpath="engine/spec/fake.py")
+        assert len(found) == 1 and "slot expression" in found[0].message
+
+    def test_scrap_page_guard_is_enough(self):
+        assert lint("""
+            def compute_slot(page, ps, pos, max_len):
+                if pos >= max_len:
+                    return SCRAP_PAGE * ps + pos % ps
+                return page * ps + pos % ps
+        """, "KGCT005", relpath="engine/spec/fake.py") == []
+
+    def test_committed_anchor_is_enough(self):
+        assert lint("""
+            def fill_row(seq, slot_mapping, ps):
+                pos = seq.num_tokens - 1
+                slot_mapping[0] = seq.pages[pos // ps] * ps + pos % ps
+        """, "KGCT005", relpath="engine/fake.py") == []
+
+    def test_out_of_scope_modules_ignored(self):
+        assert lint("""
+            def compute_slot(page, ps, pos):
+                return page * ps + pos % ps
+        """, "KGCT005", relpath="serving/fake.py") == []
+
+
+class TestAsyncioHygiene:  # KGCT006
+    def test_time_sleep_in_async_fires(self):
+        found = lint("""
+            import time
+
+            async def handler(request):
+                time.sleep(0.5)
+        """, "KGCT006")
+        assert found and "time.sleep" in found[0].message
+
+    def test_get_event_loop_fires_anywhere(self):
+        found = lint("""
+            import asyncio
+
+            def start(self):
+                self._loop = asyncio.get_event_loop()
+        """, "KGCT006")
+        assert found and "get_running_loop" in found[0].message
+
+    def test_sync_context_and_async_sleep_are_fine(self):
+        assert lint("""
+            import asyncio
+            import time
+
+            def worker():
+                time.sleep(0.5)
+
+            async def handler(request):
+                await asyncio.sleep(0.5)
+                loop = asyncio.get_running_loop()
+        """, "KGCT006") == []
+
+
+class TestMetricHygiene:  # KGCT007
+    def test_request_scope_construction_fires(self):
+        found = lint("""
+            async def handler(request):
+                h = Histogram("kgct_x_seconds")
+                h.observe(1.0)
+        """, "KGCT007")
+        assert found and "process-lifetime" in found[0].message
+
+    def test_unbounded_label_value_fires(self):
+        found = lint("""
+            def on_finish(self, seq):
+                self.ttft.observe(0.5, (seq.request_id,))
+        """, "KGCT007")
+        assert found and "unbounded" in found[0].message
+
+    def test_fstring_label_fires(self):
+        found = lint("""
+            def on_finish(self, seq, code):
+                self.ttft.observe(0.5, (f"status-{code}",))
+        """, "KGCT007")
+        assert found and "unbounded" in found[0].message
+
+    def test_init_construction_and_bounded_labels_are_fine(self):
+        assert lint("""
+            class Obs:
+                def __init__(self):
+                    self.ttft = Histogram("kgct_ttft_seconds",
+                                          labels=("outcome",))
+
+                def on_finish(self, seq, outcome):
+                    self.ttft.observe(0.5, (_outcome(seq, None),))
+        """, "KGCT007") == []
+
+
+class TestLoggingHygiene:  # KGCT008
+    def test_fstring_log_fires(self):
+        found = lint("""
+            def step(logger, arr):
+                logger.info(f"step done: {arr}")
+        """, "KGCT008")
+        assert found and "f-string" in found[0].message
+
+    def test_eager_percent_and_format_fire(self):
+        found = lint("""
+            def step(logger, arr):
+                logger.debug("x: %s" % arr)
+                logger.warning("y: {}".format(arr))
+        """, "KGCT008")
+        assert len(found) == 2
+
+    def test_lazy_template_is_fine(self):
+        assert lint("""
+            def step(logger, arr):
+                logger.info("step done: %s tokens", arr)
+        """, "KGCT008") == []
+
+
+class TestFramework:
+    def test_every_rule_has_code_name_description(self):
+        codes = [r.code for r in ALL_RULES]
+        assert len(codes) == len(set(codes)) and len(codes) >= 8
+        for rule in ALL_RULES:
+            assert rule.code.startswith("KGCT")
+            assert rule.name and rule.description
+
+    def test_unknown_select_raises(self):
+        with pytest.raises(ValueError, match="unknown rule"):
+            rules_by_code(["KGCT999"])
+
+    def test_syntax_error_is_a_finding_not_a_crash(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        findings = run_lint([bad])
+        assert len(findings) == 1 and findings[0].rule == "KGCT000"
+
+    def test_findings_sorted_and_formatted(self, tmp_path):
+        f = tmp_path / "two.py"
+        f.write_text(textwrap.dedent("""
+            import time
+
+            async def b(logger, arr):
+                time.sleep(1)
+                logger.info(f"x {arr}")
+        """))
+        findings = run_lint([f], root=tmp_path)
+        assert [x.rule for x in findings] == ["KGCT006", "KGCT008"]
+        assert findings[0].format().startswith("two.py:")

@@ -1,0 +1,268 @@
+"""Runtime sanitizers (KGCT_SANITIZE=1) under the KGCT_FAULT chaos harness.
+
+The acceptance bars, in order:
+
+1. NO-OP WHEN OFF: with KGCT_SANITIZE unset the engine holds no sanitizer
+   and outputs are byte-identical to a sanitized run (the guard observes,
+   never perturbs).
+2. A seeded NaN fault (``nan_step_output``) in the step fetch path raises
+   SanitizerError at the step that produced it.
+3. A seeded committed-slot KV write (``kv_commit_stomp``) — a REAL
+   corruption of a spec-verify slot_mapping — is refused pre-dispatch by
+   the KV shadow.
+4. The shadow's stale-slot machine enforces the rollback contract
+   (rejected-draft slots overwritten before any read) — unit-level, since
+   a correct engine never produces the violation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_gpu_cluster_tpu.analysis.sanitize import (SanitizerError,
+                                                          StepSanitizer,
+                                                          build_step_sanitizer)
+from kubernetes_gpu_cluster_tpu.config import (CacheConfig, EngineConfig,
+                                               SchedulerConfig,
+                                               get_model_config)
+from kubernetes_gpu_cluster_tpu.engine import LLMEngine, SamplingParams
+from kubernetes_gpu_cluster_tpu.engine.spec import DraftProposer
+from kubernetes_gpu_cluster_tpu.models import llama as model_lib
+from kubernetes_gpu_cluster_tpu.resilience import configure_faults
+
+pytestmark = pytest.mark.chaos
+
+_MODEL = get_model_config("debug-tiny")
+_PARAMS = model_lib.init_params(_MODEL, jax.random.key(7))
+
+REPETITIVE = [7, 3, 9, 11] * 8   # n-gram structure -> spec steps engage
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    configure_faults(None)
+    yield
+    configure_faults(None)
+
+
+class _AlwaysDraft(DraftProposer):
+    """Drafts a constant token every step: guarantees spec steps engage
+    (and, rejecting almost always, guarantees real rollbacks for the KV
+    shadow to watch) independent of what the random-weight model emits."""
+
+    def __init__(self, k, token=1):
+        super().__init__(k)
+        self.token = token
+
+    def propose(self, token_ids):
+        return [self.token] * self.k
+
+
+def make_engine(spec: bool = False):
+    cfg = EngineConfig(
+        model=_MODEL,
+        cache=CacheConfig(page_size=8, num_pages=128),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_prefill_tokens=256,
+            decode_buckets=(1, 2, 4), prefill_buckets=(32, 64, 128, 256),
+            decode_window=8,
+            spec_decode_enabled=spec, num_speculative_tokens=4))
+    engine = LLMEngine(cfg, params=_PARAMS)
+    if spec:
+        engine.scheduler.spec_proposer = _AlwaysDraft(4)
+    return engine
+
+
+class TestNoOpWhenOff:
+    def test_outputs_byte_identical_with_and_without_sanitizer(
+            self, monkeypatch):
+        monkeypatch.delenv("KGCT_SANITIZE", raising=False)
+        off = make_engine()
+        assert off._sanitizer is None
+        base = off.generate([REPETITIVE],
+                            SamplingParams(max_tokens=12, temperature=0.0))
+        monkeypatch.setenv("KGCT_SANITIZE", "1")
+        on = make_engine()
+        assert on._sanitizer is not None
+        sane = on.generate([REPETITIVE],
+                           SamplingParams(max_tokens=12, temperature=0.0))
+        assert base[0].output_token_ids == sane[0].output_token_ids
+        # the hooks actually ran (not vacuously clean)
+        assert on._sanitizer.checks > 0
+
+    def test_build_seam_reads_env(self, monkeypatch):
+        monkeypatch.delenv("KGCT_SANITIZE", raising=False)
+        assert build_step_sanitizer(8) is None
+        monkeypatch.setenv("KGCT_SANITIZE", "0")
+        assert build_step_sanitizer(8) is None
+        monkeypatch.setenv("KGCT_SANITIZE", "1")
+        assert isinstance(build_step_sanitizer(8), StepSanitizer)
+
+
+class TestSeededFaults:
+    def test_nan_step_output_caught(self, monkeypatch):
+        monkeypatch.setenv("KGCT_SANITIZE", "1")
+        engine = make_engine()
+        configure_faults("nan_step_output:times=1")
+        with pytest.raises(SanitizerError, match="non-finite logprob"):
+            engine.generate([REPETITIVE],
+                            SamplingParams(max_tokens=8, temperature=0.0))
+
+    def test_spec_rollbacks_clean_then_seeded_stomp_caught(self, monkeypatch):
+        """One spec engine, both sides of the contract. First a clean run:
+        spec decode's REAL rollbacks (garbage drafts reject constantly)
+        must not trip the shadow — rejected slots are overwritten before
+        any read, which is exactly what it watches. Then the seeded
+        committed-slot KV write (a genuine slot_mapping corruption — with
+        the sanitizer off it would poison served context silently) is
+        refused before the upload."""
+        monkeypatch.setenv("KGCT_SANITIZE", "1")
+        engine = make_engine(spec=True)
+        out = engine.generate([REPETITIVE],
+                              SamplingParams(max_tokens=12, temperature=0.0))
+        assert engine.obs.step_kind_counts["spec"] > 0
+        assert len(out[0].output_token_ids) == 12
+        assert engine._sanitizer.checks > 0
+        # Recycled request id (generate() numbers from zero per call): the
+        # previous request's rollbacks left stale shadow entries under
+        # "req-0"; a fresh sequence wearing the same id must not inherit
+        # them and false-positive on a healthy engine.
+        out2 = engine.generate([list(REPETITIVE) + [7, 3]],
+                               SamplingParams(max_tokens=8, temperature=0.0))
+        assert len(out2[0].output_token_ids) == 8
+        configure_faults("kv_commit_stomp:times=1")
+        with pytest.raises(SanitizerError, match="COMMITTED slot"):
+            engine.generate([REPETITIVE],
+                            SamplingParams(max_tokens=12, temperature=0.0))
+
+
+class _FakeSeq:
+    def __init__(self, rid, num_tokens, pages, finished=False):
+        self.request_id = rid
+        self.num_tokens = num_tokens
+        self.pages = pages
+        self.is_finished = finished
+
+
+class _FakeSpecBatch:
+    def __init__(self, seqs, seg_ids, positions, slot_mapping):
+        self.seqs = seqs
+        self.seg_ids = np.asarray(seg_ids, np.int32)
+        self.positions = np.asarray(positions, np.int32)
+        self.slot_mapping = np.asarray(slot_mapping, np.int32)
+
+
+class TestKVShadowUnit:
+    """The stale-slot machine, driven directly (a correct engine never
+    produces these traces)."""
+
+    PS = 8
+
+    def _spec_step(self, san, seq, k=2):
+        # writes positions n-1 .. n-1+k with matching slots
+        n = seq.num_tokens
+        poss = [n - 1 + i for i in range(k + 1)]
+        slots = [seq.pages[p // self.PS] * self.PS + p % self.PS
+                 for p in poss]
+        batch = _FakeSpecBatch([seq], [0] * (k + 1), poss, slots)
+        san.on_spec_dispatch(batch)
+        return batch
+
+    def test_rejected_slots_go_stale_and_overwrite_clears(self):
+        san = StepSanitizer(self.PS)
+        seq = _FakeSeq("r1", num_tokens=9, pages=[3, 4])   # committed KV: 8
+        batch = self._spec_step(san, seq, k=2)     # writes pos 8, 9, 10
+        san.on_spec_commit(batch, np.asarray([1]))  # emit 1 -> 9, 10 stale
+        assert set(san._stale["r1"]) == {9, 10}
+        # next decode window starts at the first stale position: clears it
+        seq.num_tokens = 10
+        san.on_decode_dispatch([seq], np.asarray([9]), window=8)
+        assert san._stale["r1"] == {}
+
+    def test_stale_read_detected(self):
+        san = StepSanitizer(self.PS)
+        seq = _FakeSeq("r1", num_tokens=9, pages=[3, 4])
+        batch = self._spec_step(san, seq, k=2)
+        san.on_spec_commit(batch, np.asarray([1]))  # 9, 10 stale
+        # BUG trace: committed length advances past the stale slots with
+        # no overwrite — the next window would read garbage as context.
+        seq.num_tokens = 13
+        with pytest.raises(SanitizerError, match="stale"):
+            san.on_decode_dispatch([seq], np.asarray([12]), window=8)
+
+    def test_decode_window_inside_committed_history_detected(self):
+        san = StepSanitizer(self.PS)
+        seq = _FakeSeq("r1", num_tokens=9, pages=[3, 4])
+        with pytest.raises(SanitizerError, match="committed history"):
+            san.on_decode_dispatch([seq], np.asarray([3]), window=8)
+
+    def test_cross_sequence_committed_stomp_detected(self):
+        """A slot mis-aimed into ANOTHER sequence's committed page must be
+        refused too — the writing row's own page index can't see it, the
+        batch-wide ownership map can."""
+        san = StepSanitizer(self.PS)
+        a = _FakeSeq("a", num_tokens=9, pages=[3, 4])
+        b = _FakeSeq("b", num_tokens=9, pages=[6, 7])
+        # row 0 (seq a) claims a legal position but its write slot lands in
+        # seq b's page 6, position 0 — committed history of b.
+        batch = _FakeSpecBatch([a, b], [0], [8], [6 * self.PS])
+        with pytest.raises(SanitizerError, match="owned by 'b'|owned by b"):
+            san.on_spec_dispatch(batch)
+
+    def test_recycled_request_id_does_not_inherit_stale_state(self):
+        san = StepSanitizer(self.PS)
+        old = _FakeSeq("r1", num_tokens=9, pages=[3, 4])
+        batch = self._spec_step(san, old, k=2)
+        san.on_spec_commit(batch, np.asarray([1]))
+        assert san._stale["r1"]
+        # a NEW sequence object reuses the id with fresh pages: the old
+        # stale map must be dropped, not raised over
+        fresh = _FakeSeq("r1", num_tokens=13, pages=[5, 6])
+        san.on_decode_dispatch([fresh], np.asarray([12]), window=8)
+        assert san._stale.get("r1", {}) == {}
+
+    def test_scrap_page_writes_are_ignored(self):
+        san = StepSanitizer(self.PS)
+        seq = _FakeSeq("r1", num_tokens=9, pages=[3, 4])
+        # slot < page_size -> scrap page routing, never an error
+        batch = _FakeSpecBatch([seq], [0], [8], [5])
+        san.on_spec_dispatch(batch)
+
+    def test_finished_seqs_pruned(self):
+        san = StepSanitizer(self.PS)
+        seq = _FakeSeq("r1", num_tokens=9, pages=[3, 4])
+        batch = self._spec_step(san, seq, k=2)
+        san.on_spec_commit(batch, np.asarray([1]))
+        assert "r1" in san._stale
+        other = _FakeSeq("r2", num_tokens=5, pages=[5])
+        san.on_decode_dispatch([other], np.asarray([4]), window=8)
+        assert "r1" not in san._stale   # absent from a full batch = gone
+
+
+class TestOutputGuardUnit:
+    def test_out_of_vocab_token(self):
+        san = StepSanitizer(8)
+        with pytest.raises(SanitizerError, match="out of vocab"):
+            san.check_outputs(np.asarray([[5, 900]]),
+                              np.zeros((1, 2)), None, 512, 1)
+
+    def test_inf_logprob(self):
+        san = StepSanitizer(8)
+        with pytest.raises(SanitizerError, match="non-finite"):
+            san.check_outputs(np.asarray([[5, 6]]),
+                              np.asarray([[0.0, np.inf]]), None, 512, 1)
+
+    def test_emit_mask_ignores_rejected_columns(self):
+        """Spec rows carry garbage past the accepted prefix — the guard
+        must only check what the host consumes."""
+        san = StepSanitizer(8)
+        san.check_outputs(np.asarray([[5, -1, 99999]]),
+                          np.asarray([[0.0, np.nan, np.inf]]),
+                          np.asarray([1]), 512, 1)
+
+    def test_padding_rows_ignored(self):
+        san = StepSanitizer(8)
+        san.check_outputs(np.asarray([[5], [-7]]),
+                          np.asarray([[0.0], [np.nan]]), None, 512,
+                          num_seqs=1)
