@@ -1,10 +1,11 @@
 """Serving benchmark — prints ONE JSON line for the driver.
 
 Primary metric (BASELINE.json north-star config 2): steady-state decode
-tokens/sec/chip on **Llama-3-8B int8** under continuous batching, measured on
-whatever backend is default (the driver runs this on the real TPU chip). A
-TinyLlama-1.1B bf16 config runs alongside as the continuity line with rounds
-1-4, and every config's JSON carries:
+tokens/sec/chip on **Llama-3-8B int4 (W4A16)** under continuous batching,
+measured on whatever backend is default (the driver runs this on the real
+TPU chip). The 8B int8 config runs alongside as the quant-ladder A/B (the
+r1-r5 line), a TinyLlama-1.1B bf16 config as the continuity line with
+rounds 1-4, and every config's JSON carries:
 
 - prefill throughput + TTFT p50/p95 over THREE fresh-batch trials (one trial
   collapses all samples onto the per-step boundaries; see VERDICT r4 weak #2)
@@ -102,6 +103,13 @@ SPEC_BENCH = os.environ.get("KGCT_BENCH_SPEC", "1") != "0"
 SPEC_K = int(os.environ.get("KGCT_BENCH_SPEC_K", 4))
 SPEC_BATCH = int(os.environ.get("KGCT_BENCH_SPEC_BATCH", 4))
 SPEC_MAX_NEW = int(os.environ.get("KGCT_BENCH_SPEC_MAX_NEW", 96))
+# Prefix-reuse phase (engine/kv_cache.PrefixCache): a shared-system-prompt
+# workload — cold requests with unique prompts vs warm requests sharing a
+# page-aligned prefix — showing warm-prefix TTFT collapsing toward the
+# cost of prefilling only the unique tail. KGCT_BENCH_PREFIX=0 skips.
+PREFIX_BENCH = os.environ.get("KGCT_BENCH_PREFIX", "1") != "0"
+PREFIX_REQS = int(os.environ.get("KGCT_BENCH_PREFIX_REQS", 6))
+PREFIX_TAIL = int(os.environ.get("KGCT_BENCH_PREFIX_TAIL", 16))
 
 # The stdout contract bench.py guarantees (also the --help epilog, and what
 # tests/test_bench_contract.py pins): everything before the last line is
@@ -195,31 +203,59 @@ def _percentile(xs, q, default=float("nan")):
 # Roofline model
 # --------------------------------------------------------------------------
 
-def _roofline(mcfg, quant, batch: int, ctx: int) -> dict:
-    """Modeled per-step HBM traffic and per-token matmul FLOPs for decode at
-    context length ``ctx``. Weight-streaming accounting matches
-    ops/quant.QUANT_LAYER_KEYS: all layer matmuls + lm_head stream at 1 B/w
-    under int8, embeddings/norms at the serving dtype. MoE streams ALL
-    expert weights per step (at serving batch sizes every expert is hit) but
-    only num_experts_per_tok experts contribute per-token FLOPs."""
+def _weight_stream_bytes(mcfg, quant) -> int:
+    """Modeled HBM bytes to stream every matmul weight once (one decode
+    step), at the quant ladder's REAL storage layout (ops/quant.py):
+    bf16/f32 at dtype bytes per weight; int8 at 1 B/w plus one f32 scale
+    per output channel; int4 at 0.5 B/w (two nibbles packed per byte) plus
+    one f32 scale per (input group, output channel) — the scale overhead is
+    what keeps int4 at ~0.53x int8, not an idealized 0.5x. MoE streams ALL
+    expert weights (at serving batch sizes every expert is hit)."""
     h, inter = mcfg.hidden_size, mcfg.intermediate_size
     nh, nkv, hd = mcfg.num_heads, mcfg.num_kv_heads, mcfg.head_dim
     L, V = mcfg.num_layers, mcfg.vocab_size
     dtype_bytes = 2 if mcfg.dtype == "bfloat16" else 4
-    wbytes = 1 if quant == "int8" else dtype_bytes
+    n_exp = max(mcfg.num_experts, 1)
+    gs = mcfg.quant_group_size
+    # (in_dim, out_dim, count) per streamed matmul class — matches
+    # ops/quant.QUANT_LAYER_KEYS plus lm_head.
+    mats = [(h, nh * hd, L), (h, nkv * hd, 2 * L), (nh * hd, h, L),
+            (h, inter, 2 * L * n_exp), (inter, h, L * n_exp)]
+    if not mcfg.tie_word_embeddings:
+        mats.append((h, V, 1))
+    total = 0
+    for din, dout, count in mats:
+        if quant == "int4":
+            per = din * dout // 2 + 4 * (din // gs) * dout
+        elif quant == "int8":
+            per = din * dout + 4 * dout
+        else:
+            per = din * dout * dtype_bytes
+        total += per * count
+    return total
+
+
+def _roofline(mcfg, quant, batch: int, ctx: int) -> dict:
+    """Modeled per-step HBM traffic and per-token matmul FLOPs for decode at
+    context length ``ctx``. Weight-streaming accounting matches
+    ops/quant.QUANT_LAYER_KEYS storage exactly (packed bytes + scales; see
+    _weight_stream_bytes); embeddings/norms stream at the serving dtype.
+    MoE streams ALL expert weights per step (at serving batch sizes every
+    expert is hit) but only num_experts_per_tok experts contribute
+    per-token FLOPs."""
+    h, inter = mcfg.hidden_size, mcfg.intermediate_size
+    nh, nkv, hd = mcfg.num_heads, mcfg.num_kv_heads, mcfg.head_dim
+    L, V = mcfg.num_layers, mcfg.vocab_size
 
     attn_p = h * nh * hd + 2 * h * nkv * hd + nh * hd * h
     mlp_unit = 3 * h * inter
-    n_exp = max(mcfg.num_experts, 1)
     active_exp = mcfg.num_experts_per_tok if mcfg.is_moe else 1
-    layer_streamed = attn_p + n_exp * mlp_unit          # bytes: all experts
     layer_active = attn_p + active_exp * mlp_unit       # flops: routed only
-    head_p = 0 if mcfg.tie_word_embeddings else V * h
 
     # Per decode step: every matmul weight streams once (batch amortizes);
     # each sequence reads its KV history and writes one slot.
     kv_token_bytes = 2 * L * nkv * hd * 2               # bf16 KV
-    weight_stream = L * layer_streamed * wbytes + head_p * wbytes
+    weight_stream = _weight_stream_bytes(mcfg, quant)
     step_bytes = weight_stream + batch * kv_token_bytes * ctx
     # Per-token matmul FLOPs (2 per MAC) + attention score/value FLOPs.
     flops_per_token = 2 * (L * layer_active + V * h) + 4 * L * nh * hd * ctx
@@ -239,11 +275,13 @@ def _roofline_prefill(mcfg, quant, T: int) -> dict:
 
     FLOPs: every matmul runs over all T tokens (2 FLOPs/MAC, routed experts
     only for MoE) plus causal attention score+value FLOPs (~T^2/2 valid
-    pairs). Logits project only the B sampled rows, not T — excluded, like
-    the decode model excludes sampling. Bytes: the weight stream (every
-    matmul weight once per step — amortized over T, which is why prefill is
-    compute-bound where decode is weight-streaming-bound) plus the step's
-    KV writes; activations are omitted (VMEM-resident at these shapes).
+    pairs). Logits project only the B sampled rows, not T — excluded from
+    FLOPs, like the decode model excludes sampling (the head WEIGHT still
+    counts in the byte stream: it is read every sampling step). Bytes: the
+    weight stream (every matmul weight once per step — amortized over T,
+    which is why prefill is compute-bound where decode is
+    weight-streaming-bound) plus the step's KV writes; activations are
+    omitted (VMEM-resident at these shapes).
     ``flops_per_byte`` makes the regime explicit: compared against the
     chip's peak FLOPs/peak bandwidth ratio (~240 on v5e), prefill at
     budget-sized T sits far above it — any TTFT prefill-phase time beyond
@@ -252,21 +290,17 @@ def _roofline_prefill(mcfg, quant, T: int) -> dict:
     h, inter = mcfg.hidden_size, mcfg.intermediate_size
     nh, nkv, hd = mcfg.num_heads, mcfg.num_kv_heads, mcfg.head_dim
     L = mcfg.num_layers
-    dtype_bytes = 2 if mcfg.dtype == "bfloat16" else 4
-    wbytes = 1 if quant == "int8" else dtype_bytes
 
     attn_p = h * nh * hd + 2 * h * nkv * hd + nh * hd * h
     mlp_unit = 3 * h * inter
-    n_exp = max(mcfg.num_experts, 1)
     active_exp = mcfg.num_experts_per_tok if mcfg.is_moe else 1
-    layer_streamed = attn_p + n_exp * mlp_unit
     layer_active = attn_p + active_exp * mlp_unit
 
     matmul_flops = 2 * T * L * layer_active
     attn_flops = 4 * L * nh * hd * (T * T) // 2     # causal: ~half the pairs
     flops_step = matmul_flops + attn_flops
     kv_token_bytes = 2 * L * nkv * hd * 2           # bf16 KV
-    bytes_step = L * layer_streamed * wbytes + T * kv_token_bytes
+    bytes_step = _weight_stream_bytes(mcfg, quant) + T * kv_token_bytes
     return {
         "tokens_modeled": int(T),
         "flops_per_step": int(flops_step),
@@ -569,6 +603,103 @@ def _measure_spec(model_name: str, quant, rng) -> dict:
     return out
 
 
+def _ttft_once(engine, rid, prompt, params) -> float:
+    """Submit one request on an idle engine, return its TTFT, drain."""
+    t0 = time.perf_counter()
+    engine.add_request(rid, prompt, params)
+    ttft = None
+    while engine.has_unfinished_requests() and ttft is None:
+        outs = engine.step()
+        now = time.perf_counter()
+        for o in outs:
+            if o.request_id == rid and o.new_token_ids:
+                ttft = now - t0
+                break
+    engine.abort_request(rid)
+    while engine.has_unfinished_requests():
+        engine.step()
+    return ttft if ttft is not None else float("nan")
+
+
+def _measure_prefix_reuse(model_name: str, quant, rng) -> dict:
+    """prefix_reuse phase (ROADMAP item 2's done-criterion): the
+    shared-system-prompt workload that motivates cross-request KV reuse.
+    One request at a time on a prefix-caching engine:
+
+    - COLD wave: unique prompts -> every prefix lookup misses, full-prompt
+      prefill TTFT.
+    - one seeding request with the shared prefix, then the WARM wave:
+      requests sharing that page-aligned prefix + a unique tail -> the
+      cached pages become chunked-prefill history and only the tail
+      prefills, so TTFT collapses toward first-new-token cost.
+
+    Both programs (full prefill, history-chunk) are compiled in a discarded
+    warmup pair first — never time XLA compilation. Like the spec phase,
+    this builds its own small engine after run_config freed the big one."""
+    on_tpu = jax.default_backend() == "tpu"
+    page = PAGE if PAGE is not None else (128 if on_tpu else 16)
+    shared_len = max(PROMPT_LEN // page, 1) * page      # page-aligned prefix
+    tail = PREFIX_TAIL
+    n = PREFIX_REQS
+    vocab_cap = 200                                      # safe for any vocab
+    max_new = 4
+    full_len = shared_len + tail
+    # A bucket ladder FINER than the full prompt: a warm request prefills
+    # only its tail, and the collapse is only visible if that tail lands in
+    # a small compiled bucket instead of padding back up to the cold shape.
+    ladder = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+    top = next((b for b in ladder if b >= full_len), full_len)
+    buckets = tuple(b for b in ladder if b < full_len) + (top,)
+    pages_per_seq = cdiv(full_len + max_new, page) + 1
+    cfg = EngineConfig(
+        model=get_model_config(model_name).replace(quantization=quant),
+        cache=CacheConfig(
+            page_size=page,
+            # Pool holds the live request + every cached prompt of the cold
+            # wave (the CachingPageAllocator only evicts under pressure).
+            num_pages=(2 * n + 4) * pages_per_seq + 1),
+        scheduler=SchedulerConfig(
+            max_num_seqs=2, max_prefill_tokens=top,
+            decode_buckets=(1, 2), prefill_buckets=buckets,
+            decode_window=4, mixed_batch_enabled=False,
+            enable_prefix_caching=True))
+    engine = LLMEngine(cfg, eos_token_id=None)
+    params = SamplingParams(max_tokens=max_new, temperature=0.0)
+
+    def prompt_of(prefix_seed: int, tail_seed: int) -> list:
+        p_rng = np.random.default_rng(prefix_seed)
+        t_rng = np.random.default_rng(tail_seed)
+        return (p_rng.integers(1, vocab_cap, shared_len).tolist()
+                + t_rng.integers(1, vocab_cap, tail).tolist())
+
+    # Warmup pair: compiles the full-prefill AND the cached-history
+    # (chunked) programs; TTFTs discarded.
+    _ttft_once(engine, "warm-a", prompt_of(10_000, 1), params)
+    _ttft_once(engine, "warm-b", prompt_of(10_000, 2), params)
+
+    pc = engine.scheduler.prefix_cache
+    hits0, misses0 = pc.hits, pc.misses
+    cold = [_ttft_once(engine, f"cold-{i}", prompt_of(20_000 + i, i), params)
+            for i in range(n)]
+    _ttft_once(engine, "seed", prompt_of(30_000, 100), params)
+    warm = [_ttft_once(engine, f"warm-{i}",
+                       prompt_of(30_000, 200 + i), params)
+            for i in range(n)]
+    cold_p50 = _median([t for t in cold if t == t])
+    warm_p50 = _median([t for t in warm if t == t])
+    return {
+        "n_requests": n,
+        "shared_prefix_tokens": shared_len,
+        "tail_tokens": tail,
+        "ttft_cold_p50_ms": round(cold_p50 * 1e3, 1),
+        "ttft_warm_p50_ms": round(warm_p50 * 1e3, 1),
+        "warm_over_cold": (round(warm_p50 / cold_p50, 3)
+                           if cold_p50 and cold_p50 == cold_p50 else None),
+        "cache_hits": pc.hits - hits0,
+        "cache_misses": pc.misses - misses0,
+    }
+
+
 # --------------------------------------------------------------------------
 # Per-config driver
 # --------------------------------------------------------------------------
@@ -624,6 +755,7 @@ def run_config(model_name: str, quant, batch: int, *, sustained: bool,
     mcfg = engine.config.model
     acct = _roofline(mcfg, quant, batch, ctx_mid)
     util = _utilization(acct, greedy_rate, batch)
+    param_bytes, matmul_bytes = _param_bytes(engine.params)
     # Prefill roofline at the measured operating point: one budget-bounded
     # ragged step (the whole fresh batch when it fits the budget). The
     # measured rate's utilization against the compute bound is prefill's
@@ -666,6 +798,13 @@ def run_config(model_name: str, quant, batch: int, *, sustained: bool,
         "ttft_decomposition": ttft_decomp,
         "step_phase_breakdown": phase_breakdown,
         "mixed_batch": MIXED_BATCH,
+        # Buffer-size accounting over the UPLOADED params pytree (real
+        # device buffer bytes, not modeled): the packed-int4 evidence that
+        # no dequantized weight copy was materialized — matmul_weight_bytes
+        # under int4 is ~0.53x the int8 figure, and a dequantized [in, out]
+        # copy anywhere would show up as a ~2x jump.
+        "param_bytes": param_bytes,
+        "matmul_weight_bytes": matmul_bytes,
         "roofline": {
             "chip": {"hbm_gbps_peak": CHIP_HBM_GBPS,
                      "tflops_bf16_peak": CHIP_TFLOPS_BF16},
@@ -717,6 +856,24 @@ def run_config(model_name: str, quant, batch: int, *, sustained: bool,
     return result
 
 
+def _param_bytes(params) -> tuple:
+    """(total params pytree bytes, QUANT_LAYER_KEYS+lm_head matmul bytes
+    incl. scales) as actually uploaded — sizes come from the live arrays.
+    tests/test_quant.py calls this same accounting for its 0.55x A/B, so
+    the bench report and the test pin cannot drift."""
+    from kubernetes_gpu_cluster_tpu.ops.quant import QUANT_LAYER_KEYS
+
+    total = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+    matmul = 0
+    layers = params["layers"]
+    for key in QUANT_LAYER_KEYS + ("lm_head",):
+        store = params if key == "lm_head" else layers
+        for k in (key, key + "_scale"):
+            if k in store:
+                matmul += store[k].size * store[k].dtype.itemsize
+    return int(total), int(matmul)
+
+
 def assemble_output(results: list[dict], backend: str) -> dict:
     """Fold per-config results into the single driver-facing JSON object.
 
@@ -751,6 +908,10 @@ def assemble_output(results: list[dict], backend: str) -> dict:
         # Speculative phase headline (full block in configs[-1].speculative).
         "spec_acceptance_ratio": (primary.get("speculative", {})
                                   .get("spec", {}).get("acceptance_ratio")),
+        # Prefix-reuse phase headline: warm-prefix TTFT as a fraction of
+        # cold TTFT (full block in configs[-1].prefix_reuse).
+        "prefix_warm_over_cold_ttft": (primary.get("prefix_reuse", {})
+                                       .get("warm_over_cold")),
         "configs": results,
     }
 
@@ -801,8 +962,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
             "prefill-else-decode), KGCT_BENCH_SPEC (1=speculative-decoding "
             "phase on a repetitive-suffix workload, default on; 0=skip), "
             "KGCT_BENCH_SPEC_K, KGCT_BENCH_SPEC_BATCH, "
-            "KGCT_BENCH_SPEC_MAX_NEW, KGCT_BENCH_PROMPT, KGCT_BENCH_PAGE, "
-            "KGCT_CHIP_HBM_GBPS, KGCT_CHIP_TFLOPS_BF16."))
+            "KGCT_BENCH_SPEC_MAX_NEW, KGCT_BENCH_PREFIX (1=prefix-reuse "
+            "phase: cold vs warm shared-prefix TTFT on a prefix-caching "
+            "engine, default on; 0=skip), KGCT_BENCH_PREFIX_REQS, "
+            "KGCT_BENCH_PREFIX_TAIL, KGCT_BENCH_PROMPT, KGCT_BENCH_PAGE, "
+            "KGCT_CHIP_HBM_GBPS, KGCT_CHIP_TFLOPS_BF16. KGCT_BENCH_QUANT "
+            "accepts int8 or int4 (the W4A16 dequant-fused path)."))
     return p
 
 
@@ -810,6 +975,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
 # further than losing "configs" — the primary metric/value/unit always stay.
 _DROPPABLE_HEADLINE = ("ttft_decomposition", "baseline_bar", "mixed_batch",
                        "sampled_over_greedy", "spec_acceptance_ratio",
+                       "prefix_warm_over_cold_ttft",
                        "decode_window", "prefill_budget", "vs_baseline")
 
 
@@ -879,7 +1045,8 @@ def main() -> None:
                         batch=batch, sustained=True)]
     elif on_tpu:
         # Default driver suite: continuity line first (its engine is small),
-        # then the PRIMARY 8B int8 config (BASELINE config 2) with the
+        # then the 8B int8 r1-r5 line as the quant-ladder A/B, then the
+        # PRIMARY 8B int4 config (BASELINE config 2, W4A16) with the
         # sustained-load phase. 8B decode is weight-streaming-bound, so
         # tokens/step scale with batch until HBM runs out; the r5 batch
         # ladder (interleaved probes): B=32 2335 -> B=48 3027 -> B=56 3335
@@ -889,7 +1056,11 @@ def main() -> None:
         # max_new would floor to an under-provisioned pool) + W=28 so 13
         # windows fit the 384-token budget. Slack-0 only risks a graceful
         # chain break at the request tail. r4's +3-slack B=48 OOM'd 17.25G.
-        # tinyllama runs twice: B=64 is the r1-r4 continuity line, B=256 the
+        # int4 packs the weight stream to ~0.53x int8 (roofline
+        # weight_stream_bytes), so the same B=64 shape should land
+        # ~1.5-1.8x the int8 decode rate; it also frees ~3.5 GB of HBM —
+        # a B>64 int4 ladder probe is the natural next capture. tinyllama
+        # runs twice: B=64 is the r1-r4 continuity line, B=256 the
         # batch-optimal point (same weight-amortization ladder as 8B: 9.9k
         # -> 13.8k (B=128) -> 15.4k (192) -> 16.2k (256) tok/s; B=320
         # fails compile). Larger batches trade fresh-batch TTFT for
@@ -900,6 +1071,9 @@ def main() -> None:
                    dict(model_name="tinyllama-1.1b", quant=None, batch=256,
                         sustained=False, n_windows=9),  # 11-page pool fit
                    dict(model_name="llama-3-8b", quant="int8", batch=64,
+                        sustained=False, window=28, budget=2048, n_windows=9,
+                        page_slack=0, max_new=384),
+                   dict(model_name="llama-3-8b", quant="int4", batch=64,
                         sustained=True, window=28, budget=2048, n_windows=9,
                         page_slack=0, max_new=384)]
     else:
@@ -914,6 +1088,11 @@ def main() -> None:
         # own (small-batch) engines, after run_config freed the big one.
         primary = configs[-1]
         results[-1]["speculative"] = _measure_spec(
+            primary["model_name"], primary.get("quant"), rng)
+    if PREFIX_BENCH:
+        # Prefix-reuse phase: same pattern — own small engine, primary model.
+        primary = configs[-1]
+        results[-1]["prefix_reuse"] = _measure_prefix_reuse(
             primary["model_name"], primary.get("quant"), rng)
     emit_result(assemble_output(results, backend))
 

@@ -116,9 +116,33 @@ def check_prefill_history() -> None:
     assert err < 0.06, err
 
 
+def check_int4_matmul() -> None:
+    """W4A16 dequant-fused matmul (ops/pallas/int4_matmul.py): packed tiles
+    dequantized in VMEM vs the XLA fusion path, at an 8B-decode-like shape
+    (B=64 rows, hidden 4096 -> ff 14336 column block)."""
+    from kubernetes_gpu_cluster_tpu.ops.pallas.int4_matmul import (
+        pallas_int4_matmul)
+    from kubernetes_gpu_cluster_tpu.ops.quant import (int4_matmul_xla,
+                                                      quantize_tensor_int4)
+
+    T, K, N, gs = 64, 4096, 1024, 128
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((K, N)).astype(np.float32) * K ** -0.5
+    x = jnp.asarray(rng.standard_normal((T, K)), jnp.bfloat16)
+    packed, sc = quantize_tensor_int4(w, gs)
+    packed, sc = jnp.asarray(packed), jnp.asarray(sc)
+    ref = int4_matmul_xla(x, packed, sc)
+    fn = jax.jit(lambda *a: pallas_int4_matmul(*a))
+    out = fn(x, packed, sc)
+    err = float(jnp.max(jnp.abs(out - ref)))
+    print(f"int4_matmul: max|pallas-xla| = {err:.4f}")
+    assert err < 0.06, err
+
+
 if __name__ == "__main__":
     print("backend:", jax.default_backend())
     check_decode()
     check_prefill()
     check_prefill_history()
+    check_int4_matmul()
     print("OK")

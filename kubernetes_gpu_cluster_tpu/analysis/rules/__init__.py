@@ -13,6 +13,7 @@ from .kv_commit import KVCommitSafetyRule
 from .asyncio_hygiene import AsyncioHygieneRule
 from .metric_hygiene import MetricHygieneRule
 from .logging_hygiene import LoggingHygieneRule
+from .quant_surface import QuantSurfaceRule
 
 ALL_RULES = [
     TraceSafetyRule(),
@@ -23,6 +24,7 @@ ALL_RULES = [
     AsyncioHygieneRule(),
     MetricHygieneRule(),
     LoggingHygieneRule(),
+    QuantSurfaceRule(),
 ]
 
 

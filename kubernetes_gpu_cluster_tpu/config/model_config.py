@@ -52,9 +52,15 @@ class ModelConfig:
     linear_bias: bool = False
     # Serving dtype for weights/activations; fp32 accumulation on the MXU.
     dtype: str = "bfloat16"
-    # Weight-only quantization of the big matmuls ("int8" or None): halves
-    # the HBM weight-streaming bytes that bound decode (ops/quant.py).
+    # Weight-only quantization of the big matmuls ("int8", "int4" or None):
+    # shrinks the HBM weight-streaming bytes that bound decode to 1/2 and
+    # ~1/4 of bf16 respectively (ops/quant.py). int4 packs two nibbles per
+    # byte with group-wise scales; int8 is per-output-channel.
     quantization: Optional[str] = None
+    # int4 only: input-dim rows per scale group (per-output-channel alone is
+    # too coarse at 4 bits). Must divide every matmul input dim
+    # (hidden/ff/nh*hd) and align with row-shard boundaries under tp.
+    quant_group_size: int = 128
     max_model_len: int = 4096
 
     @property

@@ -492,7 +492,8 @@ class LLMEngine:
                 hidden, kv, _ = model_lib.forward_prefill(
                     params, cfg, int_t[0], meta, kv, use_pallas=use_pallas,
                     attn_mesh=attn_mesh, attn_impl=attn_impl)
-                return model_lib.compute_logits(params, cfg, hidden), kv
+                return model_lib.compute_logits(params, cfg, hidden,
+                                                 use_pallas=use_pallas), kv
 
         def prefill_step(params, kv: KVCache, int_t, int_b, float_b,
                          bias_ids, bias_vals, key):
@@ -563,7 +564,8 @@ class LLMEngine:
                     params, cfg, int_t[0], meta, kv, page_table[0], hist_len,
                     use_pallas=use_pallas and attn_mesh is None,
                     attn_mesh=attn_mesh)
-                return model_lib.compute_logits(params, cfg, hidden), kv
+                return model_lib.compute_logits(params, cfg, hidden,
+                                                 use_pallas=use_pallas), kv
 
         def prefill_hist_step(params, kv: KVCache, int_t, int_b, float_b,
                               page_table, hist_len, out_tokens,
@@ -622,7 +624,8 @@ class LLMEngine:
             hidden, kv, _ = model_lib.forward_mixed(
                 params, cfg, int_t[0], meta, kv, use_pallas=use_pallas,
                 use_pallas_hist=use_pallas_hist, attn_mesh=attn_mesh)
-            logits = model_lib.compute_logits(params, cfg, hidden)
+            logits = model_lib.compute_logits(params, cfg, hidden,
+                                              use_pallas=use_pallas)
             logits = _maybe_bias(logits, bias_ids, bias_vals)
             presence, frequency = float_b[:, 2], float_b[:, 3]
             logits = jax.lax.cond(
@@ -672,7 +675,8 @@ class LLMEngine:
             # Verification needs logits over EVERY draft position, so the
             # vocab projection runs on all R_pad*S rows (the one place the
             # engine pays more than B logit rows; amortized by acceptance).
-            logits = model_lib.compute_logits(params, cfg, hidden)
+            logits = model_lib.compute_logits(params, cfg, hidden,
+                                              use_pallas=use_pallas)
             logits = _maybe_bias(logits, jnp.repeat(bias_ids, S, axis=0),
                                  jnp.repeat(bias_vals, S, axis=0))
             logits = logits.reshape(R_pad, S, V)
@@ -738,7 +742,8 @@ class LLMEngine:
                 hidden, kv, _ = model_lib.forward_decode(
                     params, cfg, tokens, meta, kv, use_pallas=use_pallas,
                     attn_mesh=attn_mesh)
-                return model_lib.compute_logits(params, cfg, hidden), kv
+                return model_lib.compute_logits(params, cfg, hidden,
+                                                 use_pallas=use_pallas), kv
 
         V = cfg.vocab_size
 

@@ -181,19 +181,44 @@ def _load_streamed(ckpt: _Checkpoint, cfg: ModelConfig, shardings: Any,
     reference's analogue is the pre-staged /models hostPath story,
     old_README.md:1482-1561).
 
-    Quantization note (int8, ops/quant.py): scales are per OUTPUT channel
-    over the FULL input dim. Column-sharded (out-split) weights quantize
-    their slice exactly — every shard sees the full input dim. Row-sharded
-    (in-split) weights (wo, w_down) read the full [out, in] layer row-block
-    to compute the scale, then quantize only their input columns, so every
-    shard agrees with the global scale bit-for-bit."""
-    from ..ops.quant import quantize_tensor
+    Quantization notes (ops/quant.py):
+
+    - int8: scales are per OUTPUT channel over the FULL input dim.
+      Column-sharded (out-split) weights quantize their slice exactly —
+      every shard sees the full input dim. Row-sharded (in-split) weights
+      (wo, w_down) read the full [out, in] layer row-block to compute the
+      scale, then quantize only their input columns, so every shard agrees
+      with the global scale bit-for-bit.
+    - int4: scales are per (input-dim group, output channel), and the
+      packed/scale params carry the input dim at 1/2 resp. 1/group_size
+      resolution. Column-sharded weights see the full input dim, so
+      slice-quantize == global quantize as for int8. Row-sharded weights
+      shard the GROUP axis: shard boundaries must land on group boundaries
+      (validated here), after which each shard's groups are fully contained
+      in its slice — quantizing the slice alone reproduces the global
+      packed bytes and scales bit-for-bit, with no full-row read at all."""
+    from ..ops.quant import (int4_group_scale, quantize_tensor,
+                             quantize_tensor_int4)
 
     L, d = cfg.num_layers, cfg.hidden_size
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     ff, E, V = cfg.intermediate_size, cfg.num_experts, cfg.vocab_size
     pre = "model.layers.{}."
     quant = cfg.quantization is not None
+    int4 = cfg.quantization == "int4"
+    gs = cfg.quant_group_size
+
+    def packed_shape(shape):
+        """Logical weight shape -> stored (possibly nibble-packed) shape."""
+        if not int4:
+            return shape
+        return shape[:-2] + (shape[-2] // 2,) + shape[-1:]
+
+    def scale_shape(shape):
+        """Logical weight shape -> its scale param's shape."""
+        if int4:
+            return shape[:-2] + (shape[-2] // gs,) + shape[-1:]
+        return shape[:-2] + shape[-1:]
 
     def norm_idx(idx, shape):
         out = []
@@ -302,6 +327,67 @@ def _load_streamed(ckpt: _Checkpoint, cfg: ModelConfig, shardings: Any,
                 key, ckpt.slice(pre.format(l) + suffix, (so, slice(None))))
         return per_layer
 
+    # --- int4 readers: group-wise scales, nibble-packed input dim ----------
+    def _check_group_align(r0: int, r1: int, suffix: str) -> None:
+        if r0 % gs or r1 % gs:
+            raise ValueError(
+                f"int4 row-shard slice [{r0}:{r1}) of {suffix!r} does not "
+                f"align with quant_group_size={gs}: group scales could not "
+                f"survive the sharding (lower tp or change the group size)")
+
+    def q4_w_col(suffix):
+        """int4 packed weight, column-sharded (full input dim per shard):
+        slice-quantize == global quantize, as for int8."""
+        def per_layer(l, rest):
+            si, so = rest           # si over the PACKED input dim
+            w = ckpt.slice(pre.format(l) + suffix, (so, slice(None))).T
+            wq, scale = quantize_tensor_int4(np.ascontiguousarray(w), gs)
+            scale_cache[(suffix, l, so.start, so.stop)] = scale
+            return wq[si, :]
+        return per_layer
+
+    def q4_scale_col(suffix):
+        def per_layer(l, rest):
+            sg, so = rest
+            key = (suffix, l, so.start, so.stop)
+            if key in scale_cache:
+                return scale_cache.pop(key)[sg]
+            raw = ckpt.slice(pre.format(l) + suffix, (so, slice(None)))
+            return int4_group_scale(np.ascontiguousarray(raw.T), gs)[sg]
+        return per_layer
+
+    def q4_w_row(suffix):
+        """int4 packed weight, row-sharded (input-split): group boundaries
+        align with the shard boundary (validated), so this shard's groups
+        are computed from its own rows alone — identical to the global
+        quantize, and only the shard's byte ranges are read."""
+        def per_layer(l, rest):
+            si, so = rest           # si over the PACKED input dim; so full
+            r0, r1 = si.start * 2, si.stop * 2
+            _check_group_align(r0, r1, suffix)
+            raw = ckpt.slice(pre.format(l) + suffix, (so, slice(r0, r1)))
+            wq, scale = quantize_tensor_int4(
+                np.ascontiguousarray(raw.T), gs)
+            scale_cache[(suffix, l, r0 // gs, r1 // gs)] = scale
+            return wq
+        return per_layer
+
+    def q4_scale_row(suffix):
+        def per_layer(l, rest):
+            sg, so = rest           # sg over the group axis; so full out
+            key = (suffix, l, sg.start, sg.stop)
+            if key in scale_cache:
+                return scale_cache.pop(key)
+            r0, r1 = sg.start * gs, sg.stop * gs
+            raw = ckpt.slice(pre.format(l) + suffix, (so, slice(r0, r1)))
+            return int4_group_scale(np.ascontiguousarray(raw.T), gs)
+        return per_layer
+
+    qw_col, qs_col = (q4_w_col, q4_scale_col) if int4 else (q_w_col,
+                                                            q_scale_col)
+    qw_row, qs_row = (q4_w_row, q4_scale_row) if int4 else (q_w_row,
+                                                            q_scale_row)
+
     def expert(w_name, reader):
         """[L, E, ...] from per-expert tensors; reuses a per-layer reader by
         rewriting the key suffix per expert."""
@@ -327,21 +413,21 @@ def _load_streamed(ckpt: _Checkpoint, cfg: ModelConfig, shardings: Any,
             "wv": ("self_attn.v_proj.weight", (L, d, nkv * hd))}
     for name, (suffix, shape) in attn.items():
         if quant:
-            out_layers[name] = make(shape, sh_l[name],
-                                    stacked(q_w_col(suffix)), np.int8)
+            out_layers[name] = make(packed_shape(shape), sh_l[name],
+                                    stacked(qw_col(suffix)), np.int8)
             out_layers[name + "_scale"] = make(
-                (L, shape[-1]), sh_l[name + "_scale"],
-                stacked(q_scale_col(suffix)), np.float32)
+                scale_shape(shape), sh_l[name + "_scale"],
+                stacked(qs_col(suffix)), np.float32)
         else:
             out_layers[name] = make(shape, sh_l[name],
                                     stacked(t_layer(suffix)), dtype)
     if quant:
-        out_layers["wo"] = make((L, nh * hd, d), sh_l["wo"],
-                                stacked(q_w_row("self_attn.o_proj.weight")),
+        out_layers["wo"] = make(packed_shape((L, nh * hd, d)), sh_l["wo"],
+                                stacked(qw_row("self_attn.o_proj.weight")),
                                 np.int8)
         out_layers["wo_scale"] = make(
-            (L, d), sh_l["wo_scale"],
-            stacked(q_scale_row("self_attn.o_proj.weight")), np.float32)
+            scale_shape((L, nh * hd, d)), sh_l["wo_scale"],
+            stacked(qs_row("self_attn.o_proj.weight")), np.float32)
     else:
         out_layers["wo"] = make((L, nh * hd, d), sh_l["wo"],
                                 stacked(t_layer("self_attn.o_proj.weight")),
@@ -363,16 +449,16 @@ def _load_streamed(ckpt: _Checkpoint, cfg: ModelConfig, shardings: Any,
         out_layers["router"] = make(
             (L, d, E), sh_l["router"],
             stacked(t_layer("block_sparse_moe.gate.weight")), dtype)
-        moe = {"w_gate": ("w1", (L, E, d, ff), q_w_col, q_scale_col, ff),
-               "w_up": ("w3", (L, E, d, ff), q_w_col, q_scale_col, ff),
-               "w_down": ("w2", (L, E, ff, d), q_w_row, q_scale_row, d)}
-        for name, (hf, shape, qw, qs, width) in moe.items():
+        moe = {"w_gate": ("w1", (L, E, d, ff), qw_col, qs_col),
+               "w_up": ("w3", (L, E, d, ff), qw_col, qs_col),
+               "w_down": ("w2", (L, E, ff, d), qw_row, qs_row)}
+        for name, (hf, shape, qw, qs) in moe.items():
             if quant:
                 out_layers[name] = make(
-                    shape, sh_l[name],
+                    packed_shape(shape), sh_l[name],
                     stacked(expert(hf, qw)), np.int8)
                 out_layers[name + "_scale"] = make(
-                    (L, E, width), sh_l[name + "_scale"],
+                    scale_shape(shape), sh_l[name + "_scale"],
                     stacked(expert(hf, qs)), np.float32)
             else:
                 out_layers[name] = make(shape, sh_l[name],
@@ -382,21 +468,21 @@ def _load_streamed(ckpt: _Checkpoint, cfg: ModelConfig, shardings: Any,
                "w_up": ("mlp.up_proj.weight", (L, d, ff))}
         for name, (suffix, shape) in mlp.items():
             if quant:
-                out_layers[name] = make(shape, sh_l[name],
-                                        stacked(q_w_col(suffix)), np.int8)
+                out_layers[name] = make(packed_shape(shape), sh_l[name],
+                                        stacked(qw_col(suffix)), np.int8)
                 out_layers[name + "_scale"] = make(
-                    (L, ff), sh_l[name + "_scale"],
-                    stacked(q_scale_col(suffix)), np.float32)
+                    scale_shape(shape), sh_l[name + "_scale"],
+                    stacked(qs_col(suffix)), np.float32)
             else:
                 out_layers[name] = make(shape, sh_l[name],
                                         stacked(t_layer(suffix)), dtype)
         if quant:
             out_layers["w_down"] = make(
-                (L, ff, d), sh_l["w_down"],
-                stacked(q_w_row("mlp.down_proj.weight")), np.int8)
+                packed_shape((L, ff, d)), sh_l["w_down"],
+                stacked(qw_row("mlp.down_proj.weight")), np.int8)
             out_layers["w_down_scale"] = make(
-                (L, d), sh_l["w_down_scale"],
-                stacked(q_scale_row("mlp.down_proj.weight")), np.float32)
+                scale_shape((L, ff, d)), sh_l["w_down_scale"],
+                stacked(qs_row("mlp.down_proj.weight")), np.float32)
         else:
             out_layers["w_down"] = make(
                 (L, ff, d), sh_l["w_down"],
@@ -419,7 +505,30 @@ def _load_streamed(ckpt: _Checkpoint, cfg: ModelConfig, shardings: Any,
             si, so = nidx
             return ckpt.slice(head_key, (so, si)).T
 
-        if quant:
+        if int4:
+            # Vocab-sharded head is column-class (full input dim per shard).
+            def head_q4(nidx):
+                si, so = nidx       # si over the packed input dim (full)
+                w = ckpt.slice(head_key, (so, slice(None))).T
+                wq, scale = quantize_tensor_int4(np.ascontiguousarray(w), gs)
+                scale_cache[(head_key, 0, so.start, so.stop)] = scale
+                return wq[si, :]
+
+            def head_scale4(nidx):
+                sg, so = nidx
+                key = (head_key, 0, so.start, so.stop)
+                if key in scale_cache:
+                    return scale_cache.pop(key)[sg]
+                raw = ckpt.slice(head_key, (so, slice(None)))
+                return int4_group_scale(
+                    np.ascontiguousarray(raw.T), gs)[sg]
+
+            out["lm_head"] = make(packed_shape((d, V)),
+                                  shardings["lm_head"], head_q4, np.int8)
+            out["lm_head_scale"] = make(scale_shape((d, V)),
+                                        shardings["lm_head_scale"],
+                                        head_scale4, np.float32)
+        elif quant:
             def head_q(nidx):
                 si, so = nidx
                 w = ckpt.slice(head_key, (so, slice(None))).T
@@ -551,7 +660,8 @@ def _place(params: Params, cfg: ModelConfig, dtype,
     + dtype-convert + upload, optionally into a sharded placement."""
     if cfg.quantization:
         from ..ops.quant import quantize_params
-        params = quantize_params(params, cfg.quantization)
+        params = quantize_params(params, cfg.quantization,
+                                 cfg.quant_group_size)
 
     def put(path_, x):
         # Dtype conversion stays HOST-side (numpy + ml_dtypes): handing host

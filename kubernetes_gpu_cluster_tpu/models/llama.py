@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from ..config import ModelConfig
 from ..engine.kv_cache import KVCache
+from ..ops import quant as quant_ops
 from ..ops.rope import apply_rope, rope_cos_sin
 from ..ops.attention import (
     write_kv_pages_all,
@@ -108,10 +109,11 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Optional[jnp.dtype] = N
         return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
 
     if cfg.quantization is not None:
-        if cfg.quantization != "int8":
+        if cfg.quantization not in quant_ops.QUANT_METHODS:
             raise ValueError(
-                f"unsupported quantization {cfg.quantization!r} (int8)")
-        return _init_params_int8(cfg, key, dtype, w)
+                f"unsupported quantization {cfg.quantization!r} "
+                f"(one of {quant_ops.QUANT_METHODS})")
+        return _init_params_quant(cfg, key, dtype, w)
 
     d, L = cfg.hidden_size, cfg.num_layers
     nh, nkv, hd, ff = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.intermediate_size
@@ -171,21 +173,39 @@ def _add_opt_extras(cfg: ModelConfig, layers: Params, dtype) -> None:
         layers["b_down"] = jnp.zeros((L, d), dtype)
 
 
-def _init_params_int8(cfg: ModelConfig, key: jax.Array, dtype, w) -> Params:
-    """Random-init directly in the int8 layout (same pytree structure as
-    quantize_params output). Materializing the full bf16 model first and
+def _init_params_quant(cfg: ModelConfig, key: jax.Array, dtype, w) -> Params:
+    """Random-init directly in the quantized layout (same pytree structure
+    as quantize_params output). Materializing the full bf16 model first and
     quantizing after — the naive path — peaks at 2x the bf16 footprint, which
     OOMs an 8B model on a 16 GB chip; random-init weights are synthetic
-    anyway (bench/tests), so the big matmul weights are drawn as int8
-    directly with a constant fan-in scale and nothing large ever exists in
-    bf16. Real checkpoints quantize tensor-by-tensor at load
+    anyway (bench/tests), so the big matmul weights are drawn in their
+    quantized storage directly with a constant fan-in scale and nothing
+    large ever exists in bf16. int4 draws the PACKED bytes (each holding
+    two uniform nibbles), so the init's peak footprint is the packed
+    half-size buffer. Real checkpoints quantize tensor-by-tensor at load
     (engine/weights.py)."""
     d, L = cfg.hidden_size, cfg.num_layers
     nh, nkv, hd, ff = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.intermediate_size
     E = cfg.num_experts
+    gs = cfg.quant_group_size
     keys = iter(jax.random.split(key, 24))
 
     def wq8(key, shape, fan_in):
+        if cfg.quantization == "int4":
+            # Uniform random bytes = two uniform [-8, 7] nibbles each;
+            # dequant std ~= 4.6 * scale ~= 0.66 * fan_in^-0.5 — same
+            # magnitude class as the bf16 init, quality irrelevant for
+            # random weights.
+            din = shape[-2]
+            if din % gs:
+                raise ValueError(f"int4 input dim {din} not divisible by "
+                                 f"quant_group_size {gs}")
+            packed = jax.random.randint(
+                key, shape[:-2] + (din // 2,) + shape[-1:], -128, 128,
+                jnp.int8)
+            scale = jnp.full(shape[:-2] + (din // gs,) + shape[-1:],
+                             fan_in ** -0.5 / 7.0, jnp.float32)
+            return packed, scale
         # dequant std ~= 73 * scale ~= 0.57 * fan_in^-0.5: same magnitude
         # class as the bf16 init; quality is irrelevant for random weights.
         q = jax.random.randint(key, shape, -127, 128, jnp.int8)
@@ -275,15 +295,28 @@ def _embed(params: Params, cfg: ModelConfig, tokens: jax.Array,
     return h
 
 
-def _dot(x: jax.Array, lp: Params, name: str) -> jax.Array:
-    """x @ lp[name] in f32, transparently handling int8 weights: the int8->
-    bf16 convert fuses into the dot (weights stream from HBM at half the
-    bytes) and the per-output-channel scale applies to the f32 result
-    (ops/quant.py). Dense-precision weights take the plain path."""
+def _dot(x: jax.Array, lp: Params, name: str,
+         use_pallas: Optional[bool] = None) -> jax.Array:
+    """x @ lp[name] in f32, transparently handling the quant ladder
+    (ops/quant.py) — this is the ONE sanctioned consumer of quantized
+    weights (pinned by the KGCT009 quant-surface lint rule):
+
+    - int8 (per-output-channel scale): the int8->bf16 convert fuses into
+      the dot (weights stream from HBM at half the bytes) and the scale
+      applies as one [out]-vector multiply on the f32 result.
+    - int4 (packed nibbles + group scales, ``scale.ndim == w.ndim``): the
+      dequant-fused matmul contracts per input group and folds the scales
+      into the f32 partials — no dequantized weight copy in HBM
+      (ops.quant.int4_matmul; Pallas kernel on TPU).
+    - dense-precision weights take the plain path.
+    """
     w = lp[name]
     if w.dtype == jnp.int8:
+        scale = lp[name + "_scale"]
+        if quant_ops.is_packed_int4(w, scale):
+            return quant_ops.int4_matmul(x, w, scale, use_pallas=use_pallas)
         out = jnp.dot(x, w.astype(x.dtype), preferred_element_type=jnp.float32)
-        return out * lp[name + "_scale"]
+        return out * scale
     return jnp.dot(x, w, preferred_element_type=jnp.float32)
 
 
@@ -297,7 +330,8 @@ _MLP_ACTS = {"relu": jax.nn.relu,
 
 
 def _dense_mlp(lp: Params, x: jax.Array, cfg: ModelConfig,
-               tp_axis: Optional[str] = None) -> jax.Array:
+               tp_axis: Optional[str] = None,
+               use_pallas: Optional[bool] = None) -> jax.Array:
     """Megatron MLP: gate/up column-sharded, down row-sharded. Under GSPMD
     (tp_axis=None) the psum is inserted by the partitioner; inside shard_map
     (parallel/pp.py) ``tp_axis`` names the manual mesh axis to reduce over.
@@ -305,20 +339,20 @@ def _dense_mlp(lp: Params, x: jax.Array, cfg: ModelConfig,
     biases, no gate); biases add AFTER the down-projection reduce so they
     are applied exactly once under tp."""
     if cfg.mlp_type == "mlp":
-        h = _dot(x, lp, "w_up")
+        h = _dot(x, lp, "w_up", use_pallas)
         if "b_up" in lp:
             h = h + lp["b_up"]
         h = _MLP_ACTS[cfg.mlp_act](h).astype(x.dtype)
-        out = _dot(h, lp, "w_down")
+        out = _dot(h, lp, "w_down", use_pallas)
         if tp_axis is not None:
             out = jax.lax.psum(out, tp_axis)
         if "b_down" in lp:
             out = out + lp["b_down"]
         return out.astype(x.dtype)
-    gate = _dot(x, lp, "w_gate")
-    up = _dot(x, lp, "w_up")
+    gate = _dot(x, lp, "w_gate", use_pallas)
+    up = _dot(x, lp, "w_up", use_pallas)
     h = (jax.nn.silu(gate) * up).astype(x.dtype)
-    out = _dot(h, lp, "w_down")
+    out = _dot(h, lp, "w_down", use_pallas)
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)
     return out.astype(x.dtype)
@@ -326,7 +360,8 @@ def _dense_mlp(lp: Params, x: jax.Array, cfg: ModelConfig,
 
 def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig,
              tp_axis: Optional[str] = None,
-             ep_axis: Optional[str] = None) -> jax.Array:
+             ep_axis: Optional[str] = None,
+             use_pallas: Optional[bool] = None) -> jax.Array:
     """Mixtral-style sparse MoE, dense-dispatch formulation: every expert runs
     over all tokens; combine weights zero out non-routed pairs. Exact (no
     capacity drops) and shard-friendly: under expert parallelism each device
@@ -349,10 +384,10 @@ def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig,
         combine = jax.lax.dynamic_slice_in_dim(combine, start, E_local, axis=1)
 
     def expert_fn(ep_params):
-        gate = _dot(x, ep_params, "w_gate")
-        up = _dot(x, ep_params, "w_up")
+        gate = _dot(x, ep_params, "w_gate", use_pallas)
+        up = _dot(x, ep_params, "w_up", use_pallas)
         h = (jax.nn.silu(gate) * up).astype(x.dtype)
-        return _dot(h, ep_params, "w_down")                          # [T, d]
+        return _dot(h, ep_params, "w_down", use_pallas)              # [T, d]
 
     expert_params = {k: lp[k] for k in
                      ("w_gate", "w_up", "w_down",
@@ -366,14 +401,15 @@ def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig,
     return out.astype(x.dtype)
 
 
-def _qkv(lp: Params, cfg: ModelConfig, x: jax.Array, positions: jax.Array):
+def _qkv(lp: Params, cfg: ModelConfig, x: jax.Array, positions: jax.Array,
+         use_pallas: Optional[bool] = None):
     """Project + per-head norm (qwen3) + RoPE. x: [T, d] -> q [T,nh,hd], k/v [T,nkv,hd].
     Head counts are derived from the projection widths (not cfg) so the same
     code runs on tp-local shards inside shard_map (parallel/pp.py)."""
     T = x.shape[0]
-    q = _dot(x, lp, "wq")
-    k = _dot(x, lp, "wk")
-    v = _dot(x, lp, "wv")
+    q = _dot(x, lp, "wq", use_pallas)
+    k = _dot(x, lp, "wk", use_pallas)
+    v = _dot(x, lp, "wv", use_pallas)
     if cfg.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -394,10 +430,12 @@ def _qkv(lp: Params, cfg: ModelConfig, x: jax.Array, positions: jax.Array):
 
 def _mlp_block(lp: Params, cfg: ModelConfig, x: jax.Array,
                tp_axis: Optional[str] = None,
-               ep_axis: Optional[str] = None) -> jax.Array:
+               ep_axis: Optional[str] = None,
+               use_pallas: Optional[bool] = None) -> jax.Array:
     if cfg.is_moe:
-        return _moe_mlp(lp, x, cfg, tp_axis=tp_axis, ep_axis=ep_axis)
-    return _dense_mlp(lp, x, cfg, tp_axis=tp_axis)
+        return _moe_mlp(lp, x, cfg, tp_axis=tp_axis, ep_axis=ep_axis,
+                        use_pallas=use_pallas)
+    return _dense_mlp(lp, x, cfg, tp_axis=tp_axis, use_pallas=use_pallas)
 
 
 # ---------------------------------------------------------------------------
@@ -409,6 +447,7 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array,
                 layer_slice=None,
                 tp_axis: Optional[str] = None,
                 ep_axis: Optional[str] = None,
+                use_pallas: Optional[bool] = None,
                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Scan the layer body over stacked weights.
 
@@ -444,10 +483,10 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array,
         lp, layer_idx = xs
         resid = h
         x = _norm(cfg, h, lp, "input_norm")
-        q, k, v = _qkv(lp, cfg, x, positions)
+        q, k, v = _qkv(lp, cfg, x, positions, use_pallas)
         attn_out = attn_fn(lp, q, k, v, layer_idx)
         attn_out = attn_out.reshape(x.shape[0], -1)
-        o = _dot(attn_out, lp, "wo")
+        o = _dot(attn_out, lp, "wo", use_pallas)
         if tp_axis is not None:  # row-sharded wo: partial sums over local heads
             o = jax.lax.psum(o, tp_axis)
         if "bo" in lp:           # after the reduce: applied exactly once
@@ -455,7 +494,8 @@ def _layer_scan(params: Params, cfg: ModelConfig, h: jax.Array,
         h = resid + o.astype(h.dtype)
         resid = h
         x = _norm(cfg, h, lp, "post_attn_norm")
-        h = resid + _mlp_block(lp, cfg, x, tp_axis=tp_axis, ep_axis=ep_axis)
+        h = resid + _mlp_block(lp, cfg, x, tp_axis=tp_axis, ep_axis=ep_axis,
+                               use_pallas=use_pallas)
         return h, (k, v)
 
     n_layers = jax.tree.leaves(layers)[0].shape[0]
@@ -495,7 +535,8 @@ def forward_prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
                                         scale, use_pallas=use_pallas)
 
     h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn,
-                                  layer_slice, tp_axis=tp_axis, ep_axis=ep_axis)
+                                  layer_slice, tp_axis=tp_axis,
+                                  ep_axis=ep_axis, use_pallas=use_pallas)
     if layer_slice is not None:
         kv = KVCache(k=kv.k[layer_slice[0]:layer_slice[1]],
                      v=kv.v[layer_slice[0]:layer_slice[1]])
@@ -533,7 +574,8 @@ def forward_prefill_hist(params: Params, cfg: ModelConfig, tokens: jax.Array,
             use_pallas=use_pallas)
 
     h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn,
-                                  tp_axis=tp_axis, ep_axis=ep_axis)
+                                  tp_axis=tp_axis, ep_axis=ep_axis,
+                                  use_pallas=use_pallas)
     new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
                                          meta.slot_mapping))
     selected = h[meta.logits_indices]
@@ -568,7 +610,8 @@ def forward_mixed(params: Params, cfg: ModelConfig, tokens: jax.Array,
             use_pallas=use_pallas, use_pallas_hist=use_pallas_hist,
             attn_mesh=attn_mesh)
 
-    h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn)
+    h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn,
+                                  use_pallas=use_pallas)
     new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
                                          meta.slot_mapping))
     selected = h[meta.logits_indices]
@@ -597,7 +640,8 @@ def forward_spec_verify(params: Params, cfg: ModelConfig, tokens: jax.Array,
             q, k, v, kv.k, kv.v, meta.page_tables, meta.context_lens, scale,
             layer=layer_idx, use_pallas=use_pallas)
 
-    h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn)
+    h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn,
+                                  use_pallas=use_pallas)
     new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
                                          meta.slot_mapping))
     return _norm(cfg, h, params, "final_norm"), new_kv, h
@@ -643,9 +687,12 @@ def forward_decode(params: Params, cfg: ModelConfig, tokens: jax.Array,
     return _norm(cfg, h, params, "final_norm"), new_kv, h
 
 
-def compute_logits(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
-    """hidden [B, d] -> logits [B, V] in fp32."""
+def compute_logits(params: Params, cfg: ModelConfig, hidden: jax.Array,
+                   use_pallas: Optional[bool] = None) -> jax.Array:
+    """hidden [B, d] -> logits [B, V] in fp32. ``use_pallas`` reaches the
+    dequant-fused int4 head matmul (same tri-state as the attention
+    kernels: None = auto by backend, False = the XLA kill-switch)."""
     if cfg.tie_word_embeddings:
         return jnp.dot(hidden, params["embed"].T,
                        preferred_element_type=jnp.float32)
-    return _dot(hidden, params, "lm_head")
+    return _dot(hidden, params, "lm_head", use_pallas)

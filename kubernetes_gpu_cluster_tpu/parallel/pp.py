@@ -73,7 +73,25 @@ def _layer_specs(cfg: ModelConfig) -> dict:
             specs["w_gate"] = P("pp", None, "tp")
         specs["w_up"] = P("pp", None, "tp")
         specs["w_down"] = P("pp", "tp", None)
-    if cfg.quantization:
+    if cfg.quantization == "int4":
+        # Group scales [*, n_groups, out] (ops/quant.py int4 layout): the
+        # out axis shards like the weight's out axis; the group axis
+        # partitions the INPUT dim, so row-sharded weights (wo, w_down)
+        # shard it over tp (group/shard alignment per engine/weights.py).
+        specs["wq_scale"] = P("pp", None, "tp")
+        specs["wk_scale"] = P("pp", None, "tp")
+        specs["wv_scale"] = P("pp", None, "tp")
+        specs["wo_scale"] = P("pp", "tp", None)
+        if cfg.is_moe:
+            specs["w_gate_scale"] = P("pp", "ep", None, "tp")
+            specs["w_up_scale"] = P("pp", "ep", None, "tp")
+            specs["w_down_scale"] = P("pp", "ep", "tp", None)
+        else:
+            if cfg.mlp_type != "mlp":
+                specs["w_gate_scale"] = P("pp", None, "tp")
+            specs["w_up_scale"] = P("pp", None, "tp")
+            specs["w_down_scale"] = P("pp", "tp", None)
+    elif cfg.quantization:
         # int8 scales shard like their weight's OUT axis (cf. sharding.py).
         specs["wq_scale"] = P("pp", "tp")
         specs["wk_scale"] = P("pp", "tp")
@@ -312,4 +330,5 @@ def pp_logits(params, cfg: ModelConfig, hidden: jax.Array,
     if logits_indices is not None:
         hidden = hidden[logits_indices]
     normed = model_lib._norm(cfg, hidden, params, "final_norm")
-    return model_lib.compute_logits(params, cfg, normed)
+    return model_lib.compute_logits(params, cfg, normed,
+                                    use_pallas=False)

@@ -102,7 +102,29 @@ def param_shardings(mesh: Mesh, cfg: ModelConfig) -> dict[str, Any]:
         shardings["pos_embed"] = _ns(mesh)
     if not cfg.tie_word_embeddings:
         shardings["lm_head"] = _ns(mesh, None, "tp")
-    if cfg.quantization:
+    if cfg.quantization == "int4":
+        # Group-wise scales [*, n_groups, out] (ops/quant.py int4 layout):
+        # the OUT axis shards like the weight's out axis; the GROUP axis
+        # partitions the INPUT dim, so it shards exactly where the weight's
+        # input axis does — row-sharded weights (wo, w_down) carry
+        # group-axis-sharded scales (group boundaries align with shard
+        # boundaries by the engine/weights.py alignment contract).
+        layers["wq_scale"] = _ns(mesh, None, None, "tp")
+        layers["wk_scale"] = _ns(mesh, None, None, kv_tp)
+        layers["wv_scale"] = _ns(mesh, None, None, kv_tp)
+        layers["wo_scale"] = _ns(mesh, None, "tp", None)
+        if cfg.is_moe:
+            layers["w_gate_scale"] = _ns(mesh, None, "ep", None, "tp")
+            layers["w_up_scale"] = _ns(mesh, None, "ep", None, "tp")
+            layers["w_down_scale"] = _ns(mesh, None, "ep", "tp", None)
+        else:
+            if cfg.mlp_type != "mlp":
+                layers["w_gate_scale"] = _ns(mesh, None, None, "tp")
+            layers["w_up_scale"] = _ns(mesh, None, None, "tp")
+            layers["w_down_scale"] = _ns(mesh, None, "tp", None)
+        if not cfg.tie_word_embeddings:
+            shardings["lm_head_scale"] = _ns(mesh, None, "tp")
+    elif cfg.quantization:
         # Per-output-channel scales shard exactly like their weight's OUT
         # axis (ops/quant.py): column-sharded weights carry sharded scales,
         # row-sharded weights have unsharded outputs -> replicated scales.

@@ -705,9 +705,17 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--dtype", default=None,
                    help="serving dtype override (bfloat16/float32; float16 "
                    "maps to bfloat16 on TPU)")
-    p.add_argument("--quantization", default=None, choices=["int8"],
-                   help="weight-only int8 (W8A16): halves HBM weight "
-                   "streaming; applied to any checkpoint at load")
+    p.add_argument("--quantization", default=None, choices=["int8", "int4"],
+                   help="weight-only quantization, applied to any checkpoint "
+                   "at load: int8 (W8A16, per-output-channel) halves the HBM "
+                   "weight streaming that bounds decode; int4 (W4A16, "
+                   "group-wise scales, two nibbles per byte) halves it "
+                   "again — and is what fits 14B-class models on one 16 GB "
+                   "chip")
+    p.add_argument("--quant-group-size", type=int, default=None,
+                   help="int4 only: input-dim rows per scale group "
+                   "(default 128; must divide the model's matmul input "
+                   "dims and align with tp shard boundaries)")
     p.add_argument("--enable-prefix-caching", action="store_true",
                    help="reuse KV pages across requests sharing a "
                    "page-aligned prompt prefix (vLLM parity)")
@@ -766,8 +774,15 @@ def main(argv: Optional[list[str]] = None) -> None:
         dtype = {"float16": "bfloat16", "half": "bfloat16",
                  "bf16": "bfloat16"}.get(args.dtype, args.dtype)
         model_cfg = model_cfg.replace(dtype=dtype)
+    if args.quant_group_size is not None and args.quantization != "int4":
+        # Fail loudly: a swallowed group-size flag means the operator
+        # believes int4 is active while the model serves unquantized.
+        p.error("--quant-group-size requires --quantization int4")
     if args.quantization:
         model_cfg = model_cfg.replace(quantization=args.quantization)
+        if args.quant_group_size is not None:
+            model_cfg = model_cfg.replace(
+                quant_group_size=args.quant_group_size)
     if args.trust_remote_code or args.disable_custom_all_reduce:
         logger.info("GPU-parity flags accepted and ignored "
                     "(--trust-remote-code / --disable-custom-all-reduce)")

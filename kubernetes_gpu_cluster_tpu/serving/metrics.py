@@ -76,6 +76,22 @@ class Metrics:
             "# TYPE kgct_uptime_seconds gauge",
             f"kgct_uptime_seconds {time.monotonic() - self._started:.1f}",
         ]
+        # Prefix-cache reuse (engine/kv_cache.PrefixCache counts lookups;
+        # nothing scraped them until now). Emitted unconditionally — zeros
+        # when caching is off or nothing was looked up yet — so a fresh
+        # scrape is nan-free and dashboards need no existence check.
+        pc = sched.prefix_cache
+        hits = pc.hits if pc is not None else 0
+        misses = pc.misses if pc is not None else 0
+        looked = hits + misses
+        lines += [
+            "# TYPE kgct_prefix_cache_hit_ratio gauge",
+            f"kgct_prefix_cache_hit_ratio {hits / looked if looked else 0.0}",
+            "# TYPE kgct_prefix_cache_hits_total counter",
+            f"kgct_prefix_cache_hits_total {hits}",
+            "# TYPE kgct_prefix_cache_misses_total counter",
+            f"kgct_prefix_cache_misses_total {misses}",
+        ]
         # Histograms (TTFT/TPOT/queue-wait/prefill/step/batch-size/e2e),
         # per-phase step-time counters, and the sampled-decode-ratio gauge —
         # all owned by the engine's Observability.

@@ -219,6 +219,48 @@ class TestPrefillRoofline:
         assert q8["bytes_per_step"] < bf16["bytes_per_step"]
         assert q8["flops_per_step"] == bf16["flops_per_step"]
 
+    def test_int4_packs_below_int8(self):
+        """The int4 rung streams packed bytes + group scales: under int8's
+        stream but above an idealized scale-free half (the scales are real
+        bytes; pretending otherwise would flatter the roofline)."""
+        mcfg = self._mcfg()
+        q8 = bench._roofline_prefill(mcfg, "int8", 512)
+        q4 = bench._roofline_prefill(mcfg, "int4", 512)
+        assert q4["bytes_per_step"] < q8["bytes_per_step"]
+        assert q4["flops_per_step"] == q8["flops_per_step"]
+        w8 = bench._weight_stream_bytes(mcfg, "int8")
+        w4 = bench._weight_stream_bytes(mcfg, "int4")
+        assert w8 // 2 < w4 <= 0.55 * w8
+
     def test_json_serializable(self):
         pf = bench._roofline_prefill(self._mcfg(), "int8", 1024)
         assert json.loads(json.dumps(pf)) == pf
+
+
+class TestPrefixReuseContract:
+    """The prefix_reuse phase must ride the bounded last-line contract: its
+    headline field survives parse_result_line and the full block lives in
+    the primary config (falling to stderr with the rest of "configs" when
+    the line must shrink)."""
+
+    def test_headline_parses_in_last_line(self):
+        results = _fake_results()
+        results[-1]["prefix_reuse"] = {
+            "n_requests": 6, "shared_prefix_tokens": 128, "tail_tokens": 16,
+            "ttft_cold_p50_ms": 11.2, "ttft_warm_p50_ms": 5.6,
+            "warm_over_cold": 0.5, "cache_hits": 6, "cache_misses": 7,
+        }
+        out = bench.assemble_output(results, "cpu")
+        parsed = bench.parse_result_line(json.dumps(out) + "\n")
+        assert parsed["prefix_warm_over_cold_ttft"] == 0.5
+        assert parsed["configs"][-1]["prefix_reuse"]["cache_hits"] == 6
+
+    def test_headline_is_droppable_under_the_bound(self):
+        assert "prefix_warm_over_cold_ttft" in bench._DROPPABLE_HEADLINE
+        out = bench.assemble_output(_fake_results(), "cpu")
+        line = json.dumps(bench.compact_result(out))
+        assert len(line) <= bench.RESULT_LINE_MAX
+
+    def test_absent_phase_yields_null_headline(self):
+        out = bench.assemble_output(_fake_results(), "cpu")
+        assert out["prefix_warm_over_cold_ttft"] is None

@@ -157,6 +157,26 @@ def test_spec_decode_knobs_map_to_engine_flags():
     assert "--num-speculative-tokens" not in args
 
 
+def test_quantization_knobs_map_to_engine_flags():
+    """vllmConfig.quantization / quantGroupSize render to the API server's
+    --quantization / --quant-group-size (the weight-only quant ladder's
+    deployment surface); absent renders nothing."""
+    values = copy.deepcopy(VALUES)
+    cfg = values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"]
+    cfg["quantization"] = "int4"
+    cfg["quantGroupSize"] = 64
+    ms = render_values(values)
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args[args.index("--quantization") + 1] == "int4"
+    assert args[args.index("--quant-group-size") + 1] == "64"
+    ms = render_values(copy.deepcopy(VALUES))
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--quantization" not in args
+    assert "--quant-group-size" not in args
+
+
 def test_engine_pod_graceful_drain_contract():
     """The deploy renderer must give the SIGTERM drain room to work: a
     preStop sleep so endpoint removal outruns the signal, and a termination

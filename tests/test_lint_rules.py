@@ -343,6 +343,81 @@ class TestLoggingHygiene:  # KGCT008
         """, "KGCT008") == []
 
 
+class TestQuantSurface:  # KGCT009
+    def test_direct_matmul_on_quant_key_fires(self):
+        found = lint("""
+            import jax.numpy as jnp
+
+            def attn(x, lp):
+                return jnp.dot(x, lp["wq"], preferred_element_type=None)
+        """, "KGCT009", relpath="models/fake.py")
+        assert len(found) == 1 and "_dot" in found[0].message
+
+    def test_matmul_operator_spelling_fires(self):
+        found = lint("""
+            def attn(x, lp):
+                return x @ lp["wo"]
+        """, "KGCT009", relpath="models/fake.py")
+        assert len(found) == 1 and "matmul" in found[0].message
+
+    def test_astype_dequant_copy_fires(self):
+        found = lint("""
+            import jax.numpy as jnp
+
+            def upload(lp, dtype):
+                return lp["w_down"].astype(dtype)
+        """, "KGCT009", relpath="models/fake.py")
+        assert len(found) == 1 and "dequantizes" in found[0].message
+
+    def test_sanctioned_dot_helper_is_silent(self):
+        assert lint("""
+            import jax.numpy as jnp
+
+            def _dot(x, lp, name):
+                w = lp[name]
+                if w.dtype == jnp.int8:
+                    return jnp.dot(x, w.astype(x.dtype)) * lp[name + "_scale"]
+                return jnp.dot(x, w)
+
+            def attn(x, lp):
+                return _dot(x, lp, "wq")
+        """, "KGCT009", relpath="models/fake.py") == []
+
+    def test_non_quant_keys_and_other_modules_silent(self):
+        code = """
+            import jax.numpy as jnp
+
+            def route(x, lp):
+                return jnp.dot(x, lp["router"])
+        """
+        assert lint(code, "KGCT009", relpath="models/fake.py") == []
+        # outside models/: out of scope entirely
+        assert lint("""
+            import jax.numpy as jnp
+
+            def f(x, lp):
+                return jnp.dot(x, lp["wq"])
+        """, "KGCT009", relpath="engine/fake.py") == []
+
+    def test_key_literal_drift_fires(self):
+        found = lint("""
+            QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up",
+                                "w_down", "router")
+        """, "KGCT009", relpath="ops/quant.py")
+        assert len(found) == 1 and "drifted" in found[0].message
+
+    def test_real_surface_is_in_sync(self):
+        """The shipped ops/quant.py literal matches the rule's pin (the
+        tier-1 empty-baseline run enforces this too; this pin keeps the
+        failure local and explicit)."""
+        root = Path(__file__).resolve().parent.parent
+        mod = LintModule(
+            root / "kubernetes_gpu_cluster_tpu" / "ops" / "quant.py",
+            root=root / "kubernetes_gpu_cluster_tpu")
+        [rule] = rules_by_code(["KGCT009"])
+        assert list(rule.check(mod)) == []
+
+
 class TestFramework:
     def test_every_rule_has_code_name_description(self):
         codes = [r.code for r in ALL_RULES]

@@ -45,6 +45,17 @@ def test_repeated_prompt_hits_cache_and_matches():
     assert second.output_token_ids == ref.output_token_ids
     assert eng.scheduler.prefix_cache.hits == 1
 
+    # The /metrics surface sees the same counts: hit ratio nonzero once a
+    # hit happened (fresh-scrape zero-state is pinned in test_serving.py).
+    from kubernetes_gpu_cluster_tpu.serving.metrics import Metrics
+    lines = Metrics(eng).render().splitlines()
+    [ratio] = [l for l in lines
+               if l.startswith("kgct_prefix_cache_hit_ratio ")]
+    assert float(ratio.split()[-1]) == 0.5          # 1 hit / 2 lookups
+    [hits] = [l for l in lines
+              if l.startswith("kgct_prefix_cache_hits_total ")]
+    assert int(hits.split()[-1]) == 1
+
 
 def test_shared_prefix_diverging_tail():
     rng = np.random.default_rng(1)

@@ -202,6 +202,28 @@ class TestAPIServer:
         assert 'le="+Inf"' in text
         assert "kgct_step_phase_seconds_total" in text
 
+    def test_prefix_cache_metrics_on_fresh_scrape(self, api_client):
+        """ROADMAP item 2's gauge: kgct_prefix_cache_hit_ratio plus the
+        hits/misses counters are PRESENT and nan-free even on an engine
+        that never enabled prefix caching (zeros, not absent — dashboards
+        must not need an existence check). The exposition validity
+        (nan-free, contiguous families) is pinned by
+        _assert_valid_exposition in test_metrics_endpoint above."""
+        loop, client = api_client
+
+        async def go():
+            r = await client.get("/metrics")
+            return await r.text()
+        text = loop.run_until_complete(go())
+        for name, typ in (("kgct_prefix_cache_hit_ratio", "gauge"),
+                          ("kgct_prefix_cache_hits_total", "counter"),
+                          ("kgct_prefix_cache_misses_total", "counter")):
+            assert f"# TYPE {name} {typ}" in text, name
+            [line] = [l for l in text.splitlines()
+                      if l.startswith(name + " ")]
+            value = float(line.split()[-1])
+            assert value == value and value >= 0.0, line
+
 
 def _parse_sample(line: str):
     """One exposition sample line -> (base_name, labels_dict, float_value)."""

@@ -71,11 +71,21 @@ def _trees_equal(a, b):
         np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
 
 
-@pytest.mark.parametrize("mesh_kw", [{"tp": 2}, {"pp": 2}, {"pp": 2, "tp": 2}])
-@pytest.mark.parametrize("quant", [None, "int8"])
+@pytest.mark.parametrize("mesh_kw,quant", [
+    ({"tp": 2}, None), ({"pp": 2}, None), ({"pp": 2, "tp": 2}, None),
+    ({"tp": 2}, "int8"), ({"pp": 2}, "int8"), ({"pp": 2, "tp": 2}, "int8"),
+    # int4 runs the most complete mesh only (tier-1 budget): pp x tp covers
+    # the layer split, column-sharded scales AND the group-aligned
+    # row-shard quantize in one load.
+    ({"pp": 2, "tp": 2}, "int4"),
+])
 def test_streamed_matches_full(tmp_path, mesh_kw, quant):
     path = _ckpt_dir(tmp_path)
-    cfg = config_from_hf(path).replace(dtype="float32", quantization=quant)
+    # int4 group size 32: divides the tiny model's matmul input dims (64 /
+    # 128) AND the tp=2 row-shard boundaries, exercising the group-aligned
+    # shard-quantize == global-quantize contract end to end.
+    cfg = config_from_hf(path).replace(dtype="float32", quantization=quant,
+                                       quant_group_size=32)
     full = load_weights(path, cfg)                       # host stack + upload
     mesh = make_mesh(**mesh_kw)
     shardings, _ = resolve_shardings(mesh, cfg)
@@ -83,10 +93,11 @@ def test_streamed_matches_full(tmp_path, mesh_kw, quant):
     _trees_equal(full, streamed)
 
 
-@pytest.mark.parametrize("quant", [None, "int8"])
+@pytest.mark.parametrize("quant", [None, "int8", "int4"])
 def test_streamed_moe_matches_full(tmp_path, quant):
     path = _ckpt_dir(tmp_path, moe=True)
-    cfg = config_from_hf(path).replace(dtype="float32", quantization=quant)
+    cfg = config_from_hf(path).replace(dtype="float32", quantization=quant,
+                                       quant_group_size=32)
     full = load_weights(path, cfg)
     mesh = make_mesh(ep=2, tp=2)
     shardings, _ = resolve_shardings(mesh, cfg)
