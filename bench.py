@@ -110,6 +110,15 @@ SPEC_MAX_NEW = int(os.environ.get("KGCT_BENCH_SPEC_MAX_NEW", 96))
 PREFIX_BENCH = os.environ.get("KGCT_BENCH_PREFIX", "1") != "0"
 PREFIX_REQS = int(os.environ.get("KGCT_BENCH_PREFIX_REQS", 6))
 PREFIX_TAIL = int(os.environ.get("KGCT_BENCH_PREFIX_TAIL", 16))
+# KV-swap phase (engine/kv_cache two-tier cache): a session workload
+# oversubscribed ~KGCT_BENCH_SWAP_OVERSUB x the HBM page pool, A/B
+# swap-preemption (host-DRAM tier) vs recompute-preemption on
+# identically-seeded engines, reporting resumed-session TTFT (preempt ->
+# next emitted token) and preemption counts. KGCT_BENCH_SWAP=0 skips.
+SWAP_BENCH = os.environ.get("KGCT_BENCH_SWAP", "1") != "0"
+SWAP_SESSIONS = int(os.environ.get("KGCT_BENCH_SWAP_SESSIONS", 8))
+SWAP_OVERSUB = float(os.environ.get("KGCT_BENCH_SWAP_OVERSUB", 2.0))
+SWAP_MAX_NEW = int(os.environ.get("KGCT_BENCH_SWAP_MAX_NEW", 48))
 
 # The stdout contract bench.py guarantees (also the --help epilog, and what
 # tests/test_bench_contract.py pins): everything before the last line is
@@ -700,6 +709,106 @@ def _measure_prefix_reuse(model_name: str, quant, rng) -> dict:
     }
 
 
+def _measure_swap(model_name: str, quant, rng) -> dict:
+    """kv_swap phase (ROADMAP item 2's host-offload criterion): a session
+    workload oversubscribed ~SWAP_OVERSUB x the device page pool, so the
+    scheduler must preempt, A/B'd on identically-seeded engines:
+
+    - swap arm: host-DRAM tier on — victims' committed KV moves to host and
+      readmission is a scatter + direct decode resume;
+    - recompute arm: single-tier baseline — victims re-prefill from scratch.
+
+    The headline is resumed-session TTFT: the wall gap between a session's
+    preemption (its "preempt" trace event — the same clock the step loop's
+    token timestamps use) and its NEXT emitted token. Swap replaces the
+    re-prefill with a memcpy, so its gap should sit measurably below the
+    recompute arm's at >= 2x oversubscription. Wave 1 of each arm is a
+    discarded compile warmup (never time XLA compilation)."""
+    from kubernetes_gpu_cluster_tpu.engine.kv_cache import (
+        kv_cache_bytes_per_page)
+
+    on_tpu = jax.default_backend() == "tpu"
+    page = PAGE if PAGE is not None else (128 if on_tpu else 16)
+    n = SWAP_SESSIONS
+    prompt_len = max(PROMPT_LEN // page, 1) * page
+    max_new = SWAP_MAX_NEW
+    pages_per_seq = cdiv(prompt_len + max_new, page)
+    # Oversubscribed pool: all n sessions need ~SWAP_OVERSUB x what fits.
+    num_pages = max(int(n * pages_per_seq / SWAP_OVERSUB), pages_per_seq) + 1
+    mcfg = get_model_config(model_name).replace(quantization=quant)
+    # Host tier sized to hold every session at once — the phase measures
+    # swap value, not host-pool pressure.
+    swap_gb = (n * pages_per_seq * kv_cache_bytes_per_page(
+        mcfg, CacheConfig(page_size=page)) + (1 << 20)) / (1 << 30)
+    buckets = tuple(sorted({1, 2, 4, n // 2, n} - {0}))
+    prefill_buckets = tuple(sorted({prompt_len, 2 * prompt_len}))
+    out = {}
+    for label, gb in (("recompute", 0.0), ("swap", swap_gb)):
+        cfg = EngineConfig(
+            model=mcfg,
+            cache=CacheConfig(page_size=page, num_pages=num_pages,
+                              swap_space_gb=gb),
+            scheduler=SchedulerConfig(
+                max_num_seqs=n, max_prefill_tokens=2 * prompt_len,
+                decode_buckets=buckets, prefill_buckets=prefill_buckets,
+                decode_window=4, mixed_batch_enabled=False))
+        engine = LLMEngine(cfg, eos_token_id=None)
+        params = SamplingParams(max_tokens=max_new, temperature=0.0)
+
+        def run_wave(tag: str):
+            w_rng = np.random.default_rng(1234)   # same prompts both arms
+            for i in range(n):
+                engine.add_request(
+                    f"{tag}-{i}",
+                    w_rng.integers(1, 200, prompt_len).tolist(), params)
+            tok_times: dict = {}
+            while engine.has_unfinished_requests():
+                outs = engine.step()
+                now = time.monotonic()     # the trace ring's clock
+                for o in outs:
+                    if o.new_token_ids:
+                        tok_times.setdefault(o.request_id, []).append(now)
+            latencies = []
+            for e in engine.obs.tracer.events():
+                if e.kind == "preempt" and e.request_id.startswith(tag):
+                    nxt = [t for t in tok_times.get(e.request_id, ())
+                           if t > e.ts]
+                    if nxt:
+                        latencies.append(nxt[0] - e.ts)
+            return latencies
+
+        run_wave("warm")                       # compiles; discarded
+        pre0 = dict(engine.scheduler.num_preemptions_by_kind)
+        swap0 = dict(engine.obs.swap_pages)    # warm wave swapped too
+        t0 = time.perf_counter()
+        lat = run_wave("m")
+        wall = time.perf_counter() - t0
+        kinds = engine.scheduler.num_preemptions_by_kind
+        out[label] = {
+            "wall_s": round(wall, 3),
+            "preemptions": {k: kinds[k] - pre0[k] for k in kinds},
+            "resume_ttft_p50_ms": (round(_median(lat) * 1e3, 1)
+                                   if lat else None),
+            "resumes_observed": len(lat),
+        }
+        if label == "swap":
+            out[label]["swap_out_pages"] = (engine.obs.swap_pages["out"]
+                                            - swap0["out"])
+            out[label]["swap_in_pages"] = (engine.obs.swap_pages["in"]
+                                           - swap0["in"])
+        del engine
+        gc.collect()
+    sw, rc = out["swap"], out["recompute"]
+    out["sessions"] = n
+    out["oversubscription"] = round(n * pages_per_seq / (num_pages - 1), 2)
+    out["resume_ttft_ratio"] = (
+        round(sw["resume_ttft_p50_ms"] / rc["resume_ttft_p50_ms"], 3)
+        if sw["resume_ttft_p50_ms"] and rc["resume_ttft_p50_ms"] else None)
+    out["preemptions"] = {
+        "recompute_arm": rc["preemptions"], "swap_arm": sw["preemptions"]}
+    return out
+
+
 # --------------------------------------------------------------------------
 # Per-config driver
 # --------------------------------------------------------------------------
@@ -912,6 +1021,12 @@ def assemble_output(results: list[dict], backend: str) -> dict:
         # cold TTFT (full block in configs[-1].prefix_reuse).
         "prefix_warm_over_cold_ttft": (primary.get("prefix_reuse", {})
                                        .get("warm_over_cold")),
+        # KV-swap phase headlines: resumed-session TTFT under swap as a
+        # fraction of recompute-preemption, and the per-arm preemption
+        # counts (full block in configs[-1].kv_swap).
+        "swap_resume_over_recompute_ttft": (primary.get("kv_swap", {})
+                                            .get("resume_ttft_ratio")),
+        "preemptions": primary.get("kv_swap", {}).get("preemptions"),
         "configs": results,
     }
 
@@ -965,7 +1080,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
             "KGCT_BENCH_SPEC_MAX_NEW, KGCT_BENCH_PREFIX (1=prefix-reuse "
             "phase: cold vs warm shared-prefix TTFT on a prefix-caching "
             "engine, default on; 0=skip), KGCT_BENCH_PREFIX_REQS, "
-            "KGCT_BENCH_PREFIX_TAIL, KGCT_BENCH_PROMPT, KGCT_BENCH_PAGE, "
+            "KGCT_BENCH_PREFIX_TAIL, KGCT_BENCH_SWAP (1=kv-swap phase: "
+            "oversubscribed session workload, swap-preemption vs "
+            "recompute-preemption A/B, default on; 0=skip), "
+            "KGCT_BENCH_SWAP_SESSIONS, KGCT_BENCH_SWAP_OVERSUB, "
+            "KGCT_BENCH_SWAP_MAX_NEW, KGCT_BENCH_PROMPT, KGCT_BENCH_PAGE, "
             "KGCT_CHIP_HBM_GBPS, KGCT_CHIP_TFLOPS_BF16. KGCT_BENCH_QUANT "
             "accepts int8 or int4 (the W4A16 dequant-fused path)."))
     return p
@@ -976,6 +1095,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
 _DROPPABLE_HEADLINE = ("ttft_decomposition", "baseline_bar", "mixed_batch",
                        "sampled_over_greedy", "spec_acceptance_ratio",
                        "prefix_warm_over_cold_ttft",
+                       "swap_resume_over_recompute_ttft", "preemptions",
                        "decode_window", "prefill_budget", "vs_baseline")
 
 
@@ -1093,6 +1213,11 @@ def main() -> None:
         # Prefix-reuse phase: same pattern — own small engine, primary model.
         primary = configs[-1]
         results[-1]["prefix_reuse"] = _measure_prefix_reuse(
+            primary["model_name"], primary.get("quant"), rng)
+    if SWAP_BENCH:
+        # KV-swap phase: same pattern — own small oversubscribed engines.
+        primary = configs[-1]
+        results[-1]["kv_swap"] = _measure_swap(
             primary["model_name"], primary.get("quant"), rng)
     emit_result(assemble_output(results, backend))
 

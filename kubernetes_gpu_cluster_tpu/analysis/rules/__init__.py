@@ -14,6 +14,7 @@ from .asyncio_hygiene import AsyncioHygieneRule
 from .metric_hygiene import MetricHygieneRule
 from .logging_hygiene import LoggingHygieneRule
 from .quant_surface import QuantSurfaceRule
+from .swap_order import SwapOrderRule
 
 ALL_RULES = [
     TraceSafetyRule(),
@@ -25,6 +26,7 @@ ALL_RULES = [
     MetricHygieneRule(),
     LoggingHygieneRule(),
     QuantSurfaceRule(),
+    SwapOrderRule(),
 ]
 
 

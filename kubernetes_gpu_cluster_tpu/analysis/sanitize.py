@@ -195,6 +195,19 @@ class StepSanitizer:
                 if pos >= bound:
                     self._stale.setdefault(rid, {})[pos] = slot
 
+    def on_swap_restore(self, seq) -> None:
+        """Two-tier KV cache: a sequence restored from the host tier holds
+        ONLY committed history — swap-out copies exactly the pages covering
+        positions [0, num_tokens-1), so any rejected-draft slots (always at
+        or past the committed length) died with the discarded device pages.
+        Clear their shadow records or the next decode dispatch would flag
+        positions that no longer exist as unconsumed stale KV."""
+        self.checks += 1
+        rid = seq.request_id
+        self._stale.pop(rid, None)
+        self._spec_writes.pop(rid, None)
+        self._owner[rid] = seq
+
     def on_decode_dispatch(self, seqs, positions, window: int) -> None:
         """Decode-window dispatch: writes cover ``[pos0, pos0 + window)``
         per row. The committed check is position-based (slots are computed

@@ -29,6 +29,18 @@ class CacheConfig:
     num_pages: Optional[int] = None    # explicit page count; None = derive from HBM
     hbm_utilization: float = 0.90      # fraction of free HBM to give the KV cache
     dtype: Optional[str] = None        # KV dtype; None = model dtype
+    # Host-DRAM second KV tier (vLLM swap-space parity): GB of host memory
+    # for swapped-out pages. 0 (default) disables the tier entirely and is
+    # byte-identical to the single-tier engine — preemption recomputes and
+    # prefix-cache eviction drops pages. >0 turns preempt-by-swap and
+    # prefix-spill on: the session-capacity bound becomes "<= host RAM" and
+    # warm resumption is a memcpy instead of a prefill
+    # (engine/kv_cache.HostKVPool / KVSwapper).
+    swap_space_gb: float = 0.0
+
+    @property
+    def kv_swap_enabled(self) -> bool:
+        return self.swap_space_gb > 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -100,6 +100,11 @@ def _engine_args(spec: dict) -> list[str]:
         args += ["--hbm-utilization", str(cfg["gpuMemoryUtilization"])]
     if cfg.get("maxModelLen") is not None:
         args += ["--max-model-len", str(cfg["maxModelLen"])]
+    if cfg.get("swapSpaceGB") is not None:
+        # Two-tier KV cache: host-DRAM swap space for preempt-by-swap and
+        # prefix-spill (vLLM swapSpace parity). The pod's requestMemory must
+        # budget for it on top of the process baseline.
+        args += ["--swap-space-gb", str(cfg["swapSpaceGB"])]
     if cfg.get("quantization"):
         # Weight-only quant ladder (int8 / int4) — the knob the reference's
         # values schema hinted at via quantized-checkpoint modelURLs; here

@@ -38,7 +38,8 @@ from ..ops.sampling import (apply_logit_bias, apply_penalties, build_counts,
                             token_logprobs)
 from ..resilience.faults import inject as _inject_fault
 from ..utils import cdiv, get_logger
-from .kv_cache import KVCache, allocate_kv_cache, derive_num_pages
+from .kv_cache import (KVCache, allocate_kv_cache, build_kv_swapper,
+                       derive_num_pages)
 from .sampling_params import LOGIT_BIAS_CAP, SamplingParams
 from .scheduler import ScheduledBatch, Scheduler
 from .sequence import FinishReason, Sequence, SequenceStatus
@@ -278,6 +279,30 @@ class LLMEngine:
         # rollback contract. None when off — every hook is one is-None
         # test and outputs are byte-identical with the sanitizer absent.
         self._sanitizer = build_step_sanitizer(config.cache.page_size)
+        # Two-tier KV cache (CacheConfig.swap_space_gb > 0): host-DRAM page
+        # pool + batched jitted gather/scatter. The scheduler preempts by
+        # swap instead of recompute, and the prefix cache spills evicted
+        # pages for a second-chance restore. None when off — every call
+        # site degrades to today's single-tier behavior byte-identically.
+        self.swapper = build_kv_swapper(
+            config.model, config.cache, self.kv_cache,
+            get_kv=lambda: self.kv_cache, set_kv=self._set_kv_cache,
+            obs=self.obs, jit_enabled=not config.enforce_eager,
+            kv_sharding=kv_sharding)
+        if self.swapper is not None:
+            self.scheduler.attach_swapper(self.swapper)
+            if self.scheduler.prefix_cache is not None:
+                self.scheduler.prefix_cache.attach_swapper(self.swapper)
+            if self._sanitizer is not None:
+                # The KV-slot shadow learns that a swapped-in slot is
+                # committed history (stale spec slots died with the swap).
+                self.swapper.on_restored = self._sanitizer.on_swap_restore
+
+    def _set_kv_cache(self, kv: KVCache) -> None:
+        """Swap-in rebinding seam: the scatter donates the pool, so the
+        swapper must rebind the engine's reference from its own result —
+        the same discipline every step program follows (KGCT004)."""
+        self.kv_cache = kv
 
     def _resolve_use_pallas(self, use_pallas: Optional[bool]) -> bool:
         """Decide the kernel path ONCE, at init, from static facts — backend,

@@ -17,20 +17,38 @@ paged cache is native:
   copy-on-write-free refcounts, mirroring vLLM's block manager role. Page 0 is
   reserved as a scrap page: padding tokens write there so scatter updates need
   no masking inside jit.
+
+Two-tier extension (``CacheConfig.swap_space_gb`` > 0): a SECOND page pool in
+host DRAM (``HostKVPool``) plus batched device<->host transfer primitives
+(``KVSwapper``), the vLLM swap-space role. Committed KV pages move to host
+instead of being recomputed:
+
+- scheduler preempt-by-swap (engine/scheduler.py): the victim's committed
+  pages gather to host in one jitted batched gather, and readmission is a
+  scatter + direct decode resume instead of a full re-prefill;
+- prefix-spill: LRU-evicted ``PrefixCache`` pages spill to host, and
+  ``lookup`` gets a second-chance host hit that restores the page.
+
+Transfer discipline: the gather's device->host fetch COMPLETES inside
+``swap_out`` — before the caller frees the pages and long before the next
+step's dispatch consumes the donated pool (the KGCT004/KGCT010 contracts).
 """
 
 from __future__ import annotations
 
 import hashlib
+import time
 from collections import OrderedDict
-from typing import NamedTuple, Optional
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..config import ModelConfig, CacheConfig
+from ..resilience.faults import inject as _inject_fault
 from ..utils import cdiv, get_logger
+from ..utils.math import next_power_of_2
 
 logger = get_logger("kv_cache")
 
@@ -147,6 +165,213 @@ class PageAllocator:
         return cdiv(num_tokens, self.page_size)
 
 
+class HostKVPool:
+    """Second KV tier: a page pool in host DRAM, sized by
+    ``CacheConfig.swap_space_gb``. Same ``[L, P, page_size, kv_dim]`` layout
+    as the device pool so a page moves as one contiguous fancy-index copy.
+    ``np.zeros`` backing means untouched pages cost only virtual memory —
+    the RSS bill arrives page-by-page as swap traffic actually lands. The
+    memory is ordinary pageable host memory (numpy offers no page-locked
+    allocation); page-locking the pool for faster DMA staging is open work
+    for the TPU capture (ROADMAP item 2)."""
+
+    def __init__(self, num_pages: int, num_layers: int, page_size: int,
+                 kv_dim: int, dtype):
+        assert num_pages >= 1, "host pool needs at least one page"
+        self.num_pages = num_pages
+        self.k = np.zeros((num_layers, num_pages, page_size, kv_dim), dtype)
+        self.v = np.zeros_like(self.k)
+        self._free: list[int] = list(range(num_pages - 1, -1, -1))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def can_allocate(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def allocate(self, n: int) -> list[int]:
+        if not self.can_allocate(n):
+            raise RuntimeError(
+                f"host KV pool exhausted: want {n}, free {self.num_free}")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: list[int]) -> None:
+        self._free.extend(pages)
+
+    def put(self, pages: list[int], k_np: np.ndarray, v_np: np.ndarray) -> None:
+        idx = np.asarray(pages, np.int64)
+        self.k[:, idx] = k_np
+        self.v[:, idx] = v_np
+
+    def get(self, pages: list[int]) -> tuple[np.ndarray, np.ndarray]:
+        idx = np.asarray(pages, np.int64)
+        return self.k[:, idx], self.v[:, idx]
+
+
+class KVSwapper:
+    """Device<->host page movement for the two-tier KV cache.
+
+    One batched jitted GATHER collects a sequence's pages from the device
+    pool into a contiguous ``[L, n_pad, ps, kd]`` transfer buffer, and one
+    batched SCATTER (pool donated — XLA updates it in place, like every
+    step program) restores them. Page-count inputs are padded to powers of
+    two so each direction compiles at most ``log2(max pages/seq)`` variants
+    — inside the bounded bucket grid tests/test_compile_guard.py pins.
+
+    Ordering contracts (KGCT010 polices the static half):
+
+    - ``swap_out`` returns only after ``np.asarray`` fully fetched the
+      gather — the caller may free the device pages immediately after, and
+      the next step's dispatch may consume the donated pool.
+    - ``swap_in``/``restore_page`` scatter through ``get_kv``/``set_kv`` and
+      must only run when no dispatched program is in flight (the engine's
+      schedule-time paths satisfy this; the donated input is dead the moment
+      the call returns, exactly like a step program's pool).
+
+    Padding rows of both transfers are routed to ``SCRAP_PAGE``, which never
+    backs real tokens — a padded scatter write is harmless by construction.
+    """
+
+    def __init__(self, host_pool: HostKVPool,
+                 get_kv: Callable[[], "KVCache"],
+                 set_kv: Callable[["KVCache"], None],
+                 obs=None, jit_enabled: bool = True, kv_sharding=None):
+        self.host = host_pool
+        self._get_kv = get_kv
+        self._set_kv = set_kv
+        self.obs = obs
+        # Optional host-tier reclaim hook (the prefix-spill store registers
+        # one): asked to drop LRU spilled entries when a swap-out needs room
+        # — live-session KV outranks re-computable spilled prefixes.
+        self.reclaim = None
+        # Optional restore notification (the KGCT_SANITIZE KV-slot shadow
+        # registers one): a swapped-in slot is committed history.
+        self.on_restored = None
+
+        def gather(k, v, idx):
+            return k[:, idx], v[:, idx]
+
+        def scatter(k, v, idx, k_data, v_data):
+            return k.at[:, idx].set(k_data), v.at[:, idx].set(v_data)
+
+        if jit_enabled:
+            self._gather_fn = jax.jit(gather)
+            out_s = (kv_sharding, kv_sharding) if kv_sharding is not None \
+                else None
+            self._scatter_fn = jax.jit(scatter, donate_argnums=(0, 1),
+                                       out_shardings=out_s)
+        else:
+            self._gather_fn = gather
+            self._scatter_fn = scatter
+
+    def _padded_idx(self, pages: list[int]) -> np.ndarray:
+        idx = np.full(next_power_of_2(len(pages)), SCRAP_PAGE, np.int32)
+        idx[:len(pages)] = pages
+        return idx
+
+    def _emit(self, direction: str, pages: int, dt: float,
+              request_id: str) -> None:
+        if self.obs is not None:
+            self.obs.on_swap(direction, pages, dt, request_id)
+
+    def swap_out(self, pages: list[int], request_id: str = "") -> list[int]:
+        """Gather ``pages`` from the device pool into host pages; returns
+        the host page ids. Raises when the host tier has no room even after
+        reclaim — the caller degrades to recompute-preemption. Chaos site
+        ``kv_swap_fail`` (KGCT_FAULT) forces that path deterministically."""
+        if _inject_fault("kv_swap_fail"):
+            raise RuntimeError("KGCT_FAULT kv_swap_fail: injected swap-out "
+                               "failure")
+        n = len(pages)
+        if not self.host.can_allocate(n) and self.reclaim is not None:
+            self.reclaim(n - self.host.num_free)
+        if not self.host.can_allocate(n):
+            raise RuntimeError(
+                f"host KV pool full: want {n}, free {self.host.num_free}")
+        t0 = time.perf_counter()
+        kv = self._get_kv()
+        k_g, v_g = self._gather_fn(kv.k, kv.v, self._padded_idx(pages))
+        # Fetch COMPLETES here: after this line the device pages are free to
+        # be reallocated and the donated pool free to be consumed.
+        k_np = np.asarray(k_g)[:, :n]
+        v_np = np.asarray(v_g)[:, :n]
+        host_pages = self.host.allocate(n)
+        self.host.put(host_pages, k_np, v_np)
+        self._emit("out", n, time.perf_counter() - t0, request_id)
+        return host_pages
+
+    def swap_in(self, host_pages: list[int], device_pages: list[int],
+                request_id: str = "") -> None:
+        """Scatter host pages back into freshly allocated device pages and
+        release the host copies. The device pool is donated through the
+        scatter and rebound via ``set_kv`` before return."""
+        n = len(host_pages)
+        assert n == len(device_pages)
+        t0 = time.perf_counter()
+        idx = self._padded_idx(device_pages)
+        kv = self._get_kv()
+        L, _, ps, kd = kv.k.shape
+        k_data = np.zeros((L, len(idx), ps, kd), kv.k.dtype)
+        v_data = np.zeros_like(k_data)
+        k_data[:, :n], v_data[:, :n] = self.host.get(host_pages)
+        new_k, new_v = self._scatter_fn(kv.k, kv.v, idx, k_data, v_data)
+        self._set_kv(KVCache(k=new_k, v=new_v))
+        self.host.free(host_pages)
+        self._emit("in", n, time.perf_counter() - t0, request_id)
+
+    # -- single-page convenience (prefix-spill) -----------------------------
+
+    def spill_page(self, page: int) -> Optional[int]:
+        """Best-effort single-page spill (prefix-cache eviction path): None
+        when the host tier has no room — spill never evicts host entries,
+        so session swap-outs keep priority over re-computable prefixes."""
+        if not self.host.can_allocate(1):
+            return None
+        try:
+            [hp] = self.swap_out([page])
+            return hp
+        except RuntimeError:
+            return None   # chaos-injected or raced-full: drop, don't spill
+
+    def restore_page(self, host_page: int, device_page: int) -> None:
+        self.swap_in([host_page], [device_page])
+
+    def free_host(self, host_pages: list[int]) -> None:
+        if host_pages:
+            self.host.free(host_pages)
+
+    def notify_restored(self, seq) -> None:
+        if self.on_restored is not None:
+            self.on_restored(seq)
+
+
+def build_kv_swapper(model: ModelConfig, cache: CacheConfig, kv: "KVCache",
+                     get_kv, set_kv, obs=None, jit_enabled: bool = True,
+                     kv_sharding=None) -> Optional[KVSwapper]:
+    """Size the host tier from ``swap_space_gb`` and build the swapper; None
+    (with a loud log) when the budget fits less than one page."""
+    if not cache.kv_swap_enabled:
+        return None
+    bpp = kv_cache_bytes_per_page(model, cache)
+    num_host = int(cache.swap_space_gb * (1 << 30)) // bpp
+    if num_host < 1:
+        logger.warning(
+            "kv swap disabled: swap_space_gb=%.3f fits no page (%d B/page)",
+            cache.swap_space_gb, bpp)
+        return None
+    L, _, ps, kd = kv.k.shape
+    pool = HostKVPool(num_host, L, ps, kd, np.dtype(kv.k.dtype))
+    logger.info("host KV tier: %d pages x %d tokens (%.2f GB swap space)",
+                num_host, ps, cache.swap_space_gb)
+    return KVSwapper(pool, get_kv, set_kv, obs=obs, jit_enabled=jit_enabled,
+                     kv_sharding=kv_sharding)
+
+
 class PrefixCache:
     """Automatic prefix caching: full prompt pages are content-addressed by a
     CHAINED digest (page i's key commits to all tokens 0..(i+1)*ps), so a new
@@ -162,6 +387,15 @@ class PrefixCache:
     the cache's own reference; pages still used by live sequences survive
     until their refcount drains. Digests are blake2b-chained — no
     Python-hash collisions serving wrong context.
+
+    Host spill tier (``swapper`` attached by the engine when the two-tier
+    cache is on): eviction SPILLS the victim page to host DRAM before
+    dropping it, and ``lookup`` gets a second-chance host hit — the page
+    scatters back into a fresh device page and the chain walk continues, so
+    a prefix squeezed out by page pressure costs a memcpy, not a re-prefill.
+    Host entries are a flat LRU keyed by digest: an entry whose parent left
+    the host tier becomes unreachable, drifts to the LRU head untouched, and
+    is reclaimed under the next pressure — bounded, no subtree bookkeeping.
     """
 
     def __init__(self, allocator: "PageAllocator"):
@@ -173,6 +407,25 @@ class PrefixCache:
         self._children: dict[bytes, set] = {}
         self.hits = 0
         self.misses = 0
+        # Host spill tier (two-tier KV cache). digest -> host page id;
+        # ordered for LRU reclaim when the swapper asks for room back.
+        self.swapper: Optional["KVSwapper"] = None
+        self._host_entries: "OrderedDict[bytes, int]" = OrderedDict()
+        self.host_hits = 0
+
+    def attach_swapper(self, swapper: "KVSwapper") -> None:
+        self.swapper = swapper
+        swapper.reclaim = self._reclaim_host
+
+    def _reclaim_host(self, n_pages: int) -> int:
+        """Drop LRU spilled entries so a session swap-out can land: spilled
+        prefixes are re-computable, a preempted session's KV is not."""
+        dropped = 0
+        while dropped < n_pages and self._host_entries:
+            digest, hp = self._host_entries.popitem(last=False)
+            self.swapper.free_host([hp])
+            dropped += 1
+        return dropped
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -200,20 +453,47 @@ class PrefixCache:
             n = min(n, max_tokens // ps)
         pages: list[int] = []
         matched = 0
+        parent = b""
         for digest in self._page_digests(token_ids, n, ps):
             page = self._entries.get(digest)
             if page is None:
+                page = self._second_chance(digest, parent)
+            if page is None:
                 break
             self._entries.move_to_end(digest)       # LRU touch
+            # Fork as we go (the caller's reference): a later host restore's
+            # allocate() may evict OTHER device entries under pressure, and
+            # already-matched pages must survive that on our refcount.
+            self.allocator.fork(page)
             pages.append(page)
             matched += ps
-        for p in pages:
-            self.allocator.fork(p)
+            parent = digest
         if matched:
             self.hits += 1
         else:
             self.misses += 1
         return pages, matched
+
+    def _second_chance(self, digest: bytes, parent: bytes) -> Optional[int]:
+        """Host-tier hit: restore the spilled page into a fresh device page
+        and re-enter it as a live cache entry (the allocate() below IS the
+        cache's reference, like register's fork). None on host miss or when
+        no device page can be found even after eviction."""
+        if self.swapper is None:
+            return None
+        hp = self._host_entries.pop(digest, None)
+        if hp is None:
+            return None
+        if not self.allocator.can_allocate(1):
+            self.swapper.free_host([hp])
+            return None
+        [page] = self.allocator.allocate(1)
+        self.swapper.restore_page(hp, page)
+        self._entries[digest] = page
+        if parent:
+            self._children.setdefault(parent, set()).add(digest)
+        self.host_hits += 1
+        return page
 
     def register(self, token_ids: list[int], pages: list[int]) -> None:
         """Register the full pages backing ``token_ids`` (a completed prompt
@@ -249,6 +529,13 @@ class PrefixCache:
             page = self._entries.pop(d, None)
             if page is None:
                 continue
+            if self.swapper is not None and d not in self._host_entries:
+                # Spill BEFORE the free: the gather must read the page while
+                # the cache's reference still pins it (KGCT010). Best-effort
+                # — a full host pool just drops the page as before.
+                hp = self.swapper.spill_page(page)
+                if hp is not None:
+                    self._host_entries[d] = hp
             self.allocator.free([page])
             dropped += 1
             stack.extend(self._children.pop(d, ()))

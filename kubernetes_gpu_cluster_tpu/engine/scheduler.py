@@ -7,10 +7,14 @@ here. vLLM-v0-style policy:
 - Prefills are prioritized: waiting sequences are admitted (FCFS) up to a token
   budget and batched into one ragged prefill step.
 - Otherwise all running sequences take one decode step.
-- Under KV-page pressure the youngest running sequence is preempted by
-  recompute (pages freed, sequence returns to the waiting queue) — the
-  engine-level analogue of the reference's reset-then-converge recovery
-  property (SURVEY §1 L1).
+- Under KV-page pressure the youngest running sequence is preempted: by
+  SWAP when the two-tier KV cache is on (committed pages move to host DRAM
+  in one batched gather; readmission scatters them back and resumes decode
+  directly — ``num_prefilled`` and the whole generation state survive), by
+  RECOMPUTE otherwise or when the host pool is full / a swap-out fails
+  (pages freed, sequence re-prefills from scratch) — the engine-level
+  analogue of the reference's reset-then-converge recovery property
+  (SURVEY §1 L1).
 
 Shape discipline: every batch is padded to bucketed shapes (batch size, token
 count, pages-per-seq) so the number of distinct XLA compilations is small and
@@ -122,12 +126,26 @@ class Scheduler:
             self.prefix_cache = None
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
+        # Two-tier KV cache: sequences preempted BY SWAP wait here with
+        # their committed KV parked in host DRAM (seq.host_pages), separate
+        # from ``waiting`` so none of its invariants (mid-chunk head, chunk
+        # scheduling, prefix lookups) ever see a swapped sequence. FIFO:
+        # the head keeps first claim on freed device pages. The engine
+        # attaches the swapper after construction; None = swap disabled and
+        # every preemption recomputes (byte-identical to the single tier).
+        self.swapped: deque[Sequence] = deque()
+        self.swapper = None
         # Sequences terminated by the scheduler itself (grown past pool
         # capacity) — the engine drains these into RequestOutputs so a client
         # waiting on the request still sees a finished event.
         self.terminally_finished: list[Sequence] = []
         # Monotone high-water marks for padded shapes (stats/debug).
         self.num_preemptions = 0
+        self.num_preemptions_by_kind = {"recompute": 0, "swap": 0}
+
+    def attach_swapper(self, swapper) -> None:
+        """Enable preempt-by-swap (engine/kv_cache.KVSwapper)."""
+        self.swapper = swapper
 
     # -- queue management ---------------------------------------------------
 
@@ -151,14 +169,15 @@ class Scheduler:
         self.obs.on_queued(seq, depth=len(self.waiting))
 
     def abort(self, request_id: str) -> bool:
-        for seq in list(self.waiting):
-            if seq.request_id == request_id:
-                self.waiting.remove(seq)
-                seq.status = SequenceStatus.FINISHED
-                seq.finish_reason = FinishReason.ABORT
-                self._release(seq)   # mid-chunk prefills hold pages
-                self.obs.on_finish(seq, FinishReason.ABORT)
-                return True
+        for queue in (self.waiting, self.swapped):
+            for seq in list(queue):
+                if seq.request_id == request_id:
+                    queue.remove(seq)
+                    seq.status = SequenceStatus.FINISHED
+                    seq.finish_reason = FinishReason.ABORT
+                    self._release(seq)   # device pages AND host pages
+                    self.obs.on_finish(seq, FinishReason.ABORT)
+                    return True
         for seq in self.running:
             if seq.request_id == request_id:
                 self.running.remove(seq)
@@ -170,12 +189,15 @@ class Scheduler:
         return False
 
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running or self.swapped)
 
     def _release(self, seq: Sequence) -> None:
         if seq.pages:
             self.allocator.free(seq.pages)
             seq.pages = []
+        if seq.host_pages and self.swapper is not None:
+            self.swapper.free_host(seq.host_pages)
+            seq.host_pages = []
 
     def finish(self, seq: Sequence, reason) -> None:
         seq.status = SequenceStatus.FINISHED
@@ -186,36 +208,154 @@ class Scheduler:
         self.obs.on_finish(seq, reason)
 
     def _preempt_youngest(self) -> bool:
-        """Evict the most recently admitted running sequence (recompute-style
-        preemption). Returns False if nothing can be preempted."""
+        """Evict the most recently admitted running sequence — by SWAP when
+        the host tier can take its committed pages, by RECOMPUTE otherwise.
+        Returns False if nothing can be preempted."""
         if not self.running:
             return False
         victim = self.running.pop()  # admission order => last is youngest
-        self._release(victim)
-        victim.status = SequenceStatus.PREEMPTED
-        victim.num_prefilled = 0     # pages gone: chunk progress recomputes
-        victim.prefix_checked = False  # re-lookup on readmission (cheap TTFT
-                                       # recovery when the prefix is cached)
-        # Recompute-style preemption: pages are gone; on readmission the
-        # prefill replays all_token_ids (prompt + generated so far) so the
-        # prompt/output split — and with it max_tokens accounting — is kept.
-        # INVARIANT: a mid-chunk sequence (holding pages) is only ever at
-        # waiting[0] — chunk scheduling runs on the head alone, so displacing
-        # it would strand its pages forever. Preempted victims slot in behind.
-        if self.waiting and self.waiting[0].num_prefilled > 0:
-            self.waiting.insert(1, victim)
-        else:
-            self.waiting.appendleft(victim)
+        if self._swap_out(victim):
+            return True
+        self._requeue_for_recompute(victim)
         self.num_preemptions += 1
-        self.obs.on_preempt(victim)
+        self.num_preemptions_by_kind["recompute"] += 1
+        self.obs.on_preempt(victim, kind="recompute")
         logger.warning("preempted %s (KV pages exhausted; free=%d)",
                        victim.request_id, self.allocator.num_free,
                        extra={"request_id": victim.request_id})
         return True
 
+    def _requeue_for_recompute(self, seq: Sequence) -> None:
+        """Recompute-style readmission: pages (device AND any host copy) are
+        released and on readmission the prefill replays all_token_ids
+        (prompt + generated so far) so the prompt/output split — and with it
+        max_tokens accounting — is kept. INVARIANT: a mid-chunk sequence
+        (holding pages) is only ever at waiting[0] — chunk scheduling runs
+        on the head alone, so displacing it would strand its pages forever;
+        requeued sequences slot in behind. Shared by recompute-preemption
+        and every swap path that degrades to it."""
+        self._release(seq)
+        seq.status = SequenceStatus.PREEMPTED
+        seq.num_prefilled = 0        # pages gone: chunk progress recomputes
+        seq.prefix_checked = False   # re-lookup on readmission (cheap TTFT
+                                     # recovery when the prefix is cached)
+        if self.waiting and self.waiting[0].num_prefilled > 0:
+            self.waiting.insert(1, seq)
+        else:
+            self.waiting.appendleft(seq)
+
+    def _swap_degraded_to_recompute(self) -> None:
+        """A preemption counted as swap whose RECOVERY fell back to
+        recompute (failed swap-in / unrestorable head): reclassify it so
+        kgct_preemptions_total{kind=…} — the swap-sizing signal — reflects
+        the recovery that actually happened."""
+        self.num_preemptions_by_kind["swap"] -= 1
+        self.num_preemptions_by_kind["recompute"] += 1
+
+    def _swap_out(self, victim: Sequence) -> bool:
+        """Preempt-by-swap: gather the victim's COMMITTED pages (positions
+        [0, num_tokens-1) — the window-growth tail past them holds only
+        scratch) to host, free all its device pages, park it on ``swapped``.
+        False (caller falls back to recompute) when swap is off, the host
+        pool is full, or the transfer fails (chaos site ``kv_swap_fail``) —
+        a failed swap must never wedge the victim."""
+        if self.swapper is None:
+            return False
+        n = cdiv(victim.num_tokens - 1, self.page_size)
+        if n < 1 or n > len(victim.pages):
+            return False
+        try:
+            # Gather + fetch complete inside swap_out, BEFORE the release
+            # below can hand the pages to the next allocation (KGCT010).
+            host_pages = self.swapper.swap_out(victim.pages[:n],
+                                               request_id=victim.request_id)
+        except Exception as e:
+            logger.warning("swap-out of %s failed (%s); falling back to "
+                           "recompute preemption", victim.request_id, e,
+                           extra={"request_id": victim.request_id})
+            return False
+        self._release(victim)
+        victim.status = SequenceStatus.PREEMPTED
+        victim.host_pages = host_pages
+        # num_prefilled / prefix_checked survive: readmission restores the
+        # pages and resumes decode — no prefill replay, no prefix re-lookup.
+        self.swapped.append(victim)
+        self.num_preemptions += 1
+        self.num_preemptions_by_kind["swap"] += 1
+        self.obs.on_preempt(victim, kind="swap")
+        logger.warning("swap-preempted %s (%d pages -> host; host free=%d)",
+                       victim.request_id, n, self.swapper.host.num_free,
+                       extra={"request_id": victim.request_id})
+        return True
+
+    def _restore_swapped(self) -> None:
+        """Readmit swapped sequences (FIFO): allocate device pages covering
+        the committed KV, scatter the host copy back, and rejoin ``running``
+        directly — the next decode/mixed/spec batch carries the sequence as
+        if it never left. A blocked head keeps first claim on freed pages
+        (this runs before any admission on every schedule call). A failed
+        swap-in degrades to recompute-preemption rather than wedging."""
+        while self.swapped:
+            seq = self.swapped[0]
+            if len(self.running) >= self.max_num_seqs:
+                return
+            need = cdiv(seq.num_tokens - 1, self.page_size)
+            # Gate on pages for the committed KV PLUS the next decode
+            # window: a bare-committed restore would be the very next
+            # growth call's youngest victim, thrashing the same pages
+            # through the host tier every step while starving the transfer
+            # bus. (Growth still does the actual window allocation.)
+            last = seq.last_window_pos(seq.num_tokens - 1,
+                                       self.config.scheduler.decode_window,
+                                       self.config.effective_max_len)
+            want = max(need, cdiv(last + 1, self.page_size))
+            if want > self.allocator.num_pages - 1:
+                # Permanently unrestorable: the gate exceeds TOTAL pool
+                # capacity (num_tokens is frozen while swapped, so this
+                # never heals). Degrade to recompute-readmission — the
+                # waiting path's capacity machinery then owns the outcome
+                # (churn or LENGTH-terminate), exactly as with swap off;
+                # leaving it on `swapped` would spin schedule() forever.
+                self.swapped.popleft()
+                self._requeue_for_recompute(seq)   # drops the host copy too
+                self._swap_degraded_to_recompute()
+                logger.warning(
+                    "%s unrestorable by swap (%d pages > pool %d); "
+                    "recompute", seq.request_id, want,
+                    self.allocator.num_pages - 1,
+                    extra={"request_id": seq.request_id})
+                continue
+            if not self.allocator.can_allocate(want):
+                return
+            pages = self.allocator.allocate(need)
+            try:
+                self.swapper.swap_in(seq.host_pages, pages,
+                                     request_id=seq.request_id)
+            except Exception as e:
+                logger.warning("swap-in of %s failed (%s); recompute",
+                               seq.request_id, e,
+                               extra={"request_id": seq.request_id})
+                self.allocator.free(pages)
+                self.swapped.popleft()
+                self._requeue_for_recompute(seq)   # drops the host copy too
+                self._swap_degraded_to_recompute()
+                continue
+            self.swapped.popleft()
+            seq.pages = pages
+            seq.host_pages = []
+            seq.status = SequenceStatus.RUNNING
+            self.running.append(seq)
+            self.swapper.notify_restored(seq)
+            self.obs.on_scheduled(seq, 1)    # emits the "resume" event
+
     # -- scheduling ---------------------------------------------------------
 
     def schedule(self) -> Optional[ScheduledBatch]:
+        # Swap-readmission first: restored sequences rejoin ``running`` and
+        # ride whatever batch this very call builds — resumption is a
+        # memcpy plus a decode step, never a prefill.
+        if self.swapped:
+            self._restore_swapped()
         # Stall-free mixing: when running decodes and waiting prefill work
         # coexist, one device step carries both (engine/mixed_batch.py).
         # Every other state — and every case mixing cannot serve (no budget

@@ -12,7 +12,8 @@ from .sampling_params import SamplingParams
 class SequenceStatus(enum.Enum):
     WAITING = "waiting"        # queued, no KV pages yet
     RUNNING = "running"        # resident in the batch
-    PREEMPTED = "preempted"    # evicted under memory pressure; will recompute
+    PREEMPTED = "preempted"    # evicted under memory pressure; resumes by
+                               # swap-in (host KV tier) or recompute
     FINISHED = "finished"
 
 
@@ -38,6 +39,9 @@ class Sequence:
         self.status = SequenceStatus.WAITING
         self.finish_reason: Optional[FinishReason] = None
         self.pages: list[int] = []
+        # Two-tier KV cache: host-pool page ids holding this sequence's
+        # committed KV while it is preempted-by-swap (engine/kv_cache).
+        self.host_pages: list[int] = []
         self.arrival_time = time.monotonic()
         self.first_token_time: Optional[float] = None  # for TTFT metrics
         # Lifecycle timestamps/counters for the observability layer: first

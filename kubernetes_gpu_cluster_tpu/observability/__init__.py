@@ -84,6 +84,13 @@ class Observability:
         # counters, and the bench speculative readout.
         self.spec_drafted_tokens = 0
         self.spec_accepted_tokens = 0
+        # Two-tier KV cache: pages moved device<->host (preempt-by-swap +
+        # prefix-spill) and the per-transfer latency split by direction —
+        # feeds kgct_kv_swap_{out,in}_pages_total and kgct_kv_swap_seconds.
+        self.swap_pages = {"out": 0, "in": 0}
+        self.swap_latency = Histogram(
+            "kgct_kv_swap_seconds", "host<->device KV page transfer latency",
+            labels=("dir",))
 
     # -- request lifecycle hooks (engine + scheduler) ------------------------
 
@@ -106,10 +113,20 @@ class Observability:
         self.tracer.emit("prefill_chunk", seq.request_id,
                          start=start, end=end, total=total)
 
-    def on_preempt(self, seq) -> None:
+    def on_preempt(self, seq, kind: str = "recompute") -> None:
         seq.preempt_count += 1
-        self.tracer.emit("preempt", seq.request_id,
+        self.tracer.emit("preempt", seq.request_id, preempt_kind=kind,
                          preempt_count=seq.preempt_count)
+
+    def on_swap(self, direction: str, pages: int, duration_s: float,
+                request_id: str = "") -> None:
+        """One two-tier KV transfer: ``direction`` "out" (device->host) or
+        "in" (host->device), ``pages`` moved, wall latency including the
+        host-side copy."""
+        if direction in self.swap_pages:
+            self.swap_pages[direction] += pages
+        self.swap_latency.observe(duration_s, (direction,))
+        self.tracer.emit("swap", request_id, dir=direction, pages=pages)
 
     def on_first_token(self, seq, fetch_s: float = 0.0) -> None:
         ttft = seq.first_token_time - seq.arrival_time
@@ -249,6 +266,12 @@ class Observability:
         lines.append("# TYPE kgct_spec_accepted_tokens_total counter")
         lines.append("kgct_spec_accepted_tokens_total %d"
                      % self.spec_accepted_tokens)
+        lines.append("# TYPE kgct_kv_swap_out_pages_total counter")
+        lines.append("kgct_kv_swap_out_pages_total %d"
+                     % self.swap_pages["out"])
+        lines.append("# TYPE kgct_kv_swap_in_pages_total counter")
+        lines.append("kgct_kv_swap_in_pages_total %d" % self.swap_pages["in"])
+        lines.extend(self.swap_latency.render())
         return lines
 
     def export_perfetto(self) -> dict:

@@ -24,10 +24,12 @@ from typing import Optional
 # Typed event kinds (the request lifecycle, in rough order). "decode",
 # "mixed" and "spec" are engine-wide per-step events (empty request id); a
 # "mixed" event carries the step's prefill/decode token split, a "spec"
-# event the drafted/accepted draft-token counts.
+# event the drafted/accepted draft-token counts. "preempt" carries the
+# preemption kind (recompute|swap) and "swap" a two-tier KV transfer's
+# direction + page count.
 EVENT_KINDS = ("arrival", "queued", "scheduled", "prefill_chunk",
                "first_token", "decode", "mixed", "spec", "preempt",
-               "resume", "finish", "abort")
+               "swap", "resume", "finish", "abort")
 
 # Events that OPEN / CLOSE a request's async span in the Perfetto export.
 _OPEN = "arrival"

@@ -25,6 +25,8 @@ point (grep for ``inject(`` / ``fault_value(``):
                        (dead follower -> group abort)
 - ``queue_wait_est``   admission controller: the queue-wait estimate is
                        forced to ``value`` seconds (deterministic shedding)
+- ``kv_swap_fail``     kv swapper: swap-out raises (two-tier KV cache ->
+                       graceful recompute-preemption fallback)
 
 Params (all optional): ``p`` fire probability in [0, 1] (default 1; drawn
 from a PRIVATE ``random.Random(seed)`` per rule, so sequences are

@@ -202,7 +202,8 @@ class APIServer:
     async def health(self, request: web.Request) -> web.Response:
         sched = self.engine.engine.scheduler
         body = {"status": "ok", "model": self.model_name,
-                "waiting": len(sched.waiting), "running": len(sched.running)}
+                "waiting": len(sched.waiting), "running": len(sched.running),
+                "swapped": len(sched.swapped)}
         if self.drain_state.is_draining:
             body["status"] = self.drain_state.state
             return web.json_response(body, status=503)
@@ -702,6 +703,16 @@ def main(argv: Optional[list[str]] = None) -> None:
                    dest="hbm_utilization", type=float, default=0.90,
                    help="fraction of free HBM given to the KV page pool")
     p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--swap-space-gb", "--swap-space", dest="swap_space_gb",
+                   type=float, default=0.0,
+                   help="host-DRAM KV swap space in GB (vLLM swap-space "
+                   "parity). >0 turns on the two-tier KV cache: under page "
+                   "pressure the scheduler preempts by SWAP (committed KV "
+                   "pages move to host and readmission resumes decode via "
+                   "a memcpy instead of a re-prefill) and evicted "
+                   "prefix-cache pages spill to host for second-chance "
+                   "reuse. 0 (default) keeps the single-tier "
+                   "recompute-preemption behavior")
     p.add_argument("--dtype", default=None,
                    help="serving dtype override (bfloat16/float32; float16 "
                    "maps to bfloat16 on TPU)")
@@ -789,7 +800,8 @@ def main(argv: Optional[list[str]] = None) -> None:
     from ..config import SchedulerConfig
     config = EngineConfig(
         model=model_cfg,
-        cache=CacheConfig(hbm_utilization=args.hbm_utilization),
+        cache=CacheConfig(hbm_utilization=args.hbm_utilization,
+                          swap_space_gb=args.swap_space_gb),
         scheduler=SchedulerConfig(
             max_num_seqs=args.max_num_seqs,
             enable_prefix_caching=args.enable_prefix_caching,

@@ -63,12 +63,20 @@ class Metrics:
             f"kgct_prefill_tokens_total {stats.prefill_tokens}",
             "# TYPE kgct_engine_steps_total counter",
             f"kgct_engine_steps_total {stats.steps}",
+            # Split by kind (ROADMAP item 2): "swap" preemptions park KV in
+            # host DRAM and resume via memcpy, "recompute" ones burn a full
+            # re-prefill — the ratio is the two-tier cache's value signal.
             "# TYPE kgct_preemptions_total counter",
-            f"kgct_preemptions_total {sched.num_preemptions}",
+            'kgct_preemptions_total{kind="recompute"} %d'
+            % sched.num_preemptions_by_kind["recompute"],
+            'kgct_preemptions_total{kind="swap"} %d'
+            % sched.num_preemptions_by_kind["swap"],
             "# TYPE kgct_num_waiting gauge",
             f"kgct_num_waiting {len(sched.waiting)}",
             "# TYPE kgct_num_running gauge",
             f"kgct_num_running {len(sched.running)}",
+            "# TYPE kgct_num_swapped gauge",
+            f"kgct_num_swapped {len(sched.swapped)}",
             "# TYPE kgct_kv_pages_total gauge",
             f"kgct_kv_pages_total {alloc.num_pages}",
             "# TYPE kgct_kv_pages_free gauge",
@@ -91,6 +99,22 @@ class Metrics:
             f"kgct_prefix_cache_hits_total {hits}",
             "# TYPE kgct_prefix_cache_misses_total counter",
             f"kgct_prefix_cache_misses_total {misses}",
+            # Second-chance restores of host-spilled prefix pages.
+            "# TYPE kgct_prefix_cache_host_hits_total counter",
+            "kgct_prefix_cache_host_hits_total %d"
+            % (pc.host_hits if pc is not None else 0),
+        ]
+        # Host KV tier occupancy (two-tier cache). Zeros when swap is off —
+        # a fresh scrape stays nan-free and dashboards need no existence
+        # check, same contract as the prefix-cache series above.
+        swapper = getattr(eng, "swapper", None)
+        host_total = swapper.host.num_pages if swapper is not None else 0
+        host_used = swapper.host.num_in_use if swapper is not None else 0
+        lines += [
+            "# TYPE kgct_kv_host_pages_total gauge",
+            f"kgct_kv_host_pages_total {host_total}",
+            "# TYPE kgct_kv_host_pages_in_use gauge",
+            f"kgct_kv_host_pages_in_use {host_used}",
         ]
         # Histograms (TTFT/TPOT/queue-wait/prefill/step/batch-size/e2e),
         # per-phase step-time counters, and the sampled-decode-ratio gauge —

@@ -260,7 +260,12 @@ def group_abort(engine: LLMEngine) -> int:
     (or detaches) with no leaked device state, and waiters see explicit
     aborts instead of a silent hang. Returns the number of aborted
     requests."""
-    seqs = list(engine.scheduler.waiting) + list(engine.scheduler.running)
+    # Swapped sequences included: left behind they would be restored by the
+    # drain loop's schedule calls and keep generating on a dead group.
+    # getattr: follower protocol tests drive this with duck-typed engines
+    # that predate the two-tier cache.
+    seqs = (list(engine.scheduler.waiting) + list(engine.scheduler.running)
+            + list(getattr(engine.scheduler, "swapped", ())))
     for seq in seqs:
         try:
             engine.abort_request(seq.request_id)

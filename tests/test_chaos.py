@@ -443,6 +443,38 @@ class TestMultihostFollower:
         assert engine.aborted == []
 
 
+class TestKVSwapChaos:
+    def test_swap_out_failure_degrades_to_recompute_never_wedges(self):
+        """KGCT_FAULT=kv_swap_fail: every swap-out raises inside the
+        swapper. The scheduler must degrade each preemption to recompute —
+        the victim re-prefills and finishes, nothing wedges, no sequence is
+        stranded on the swapped queue, and no host page leaks."""
+        from kubernetes_gpu_cluster_tpu.engine import LLMEngine
+
+        cfg = EngineConfig(
+            model=get_model_config("debug-tiny"),
+            cache=CacheConfig(page_size=8, num_pages=8, swap_space_gb=0.05),
+            scheduler=SchedulerConfig(max_num_seqs=4, max_prefill_tokens=256,
+                                      decode_buckets=(1, 2, 4),
+                                      prefill_buckets=(32, 64),
+                                      decode_window=4))
+        eng = LLMEngine(cfg)
+        assert eng.swapper is not None
+        configure_faults("kv_swap_fail")
+        outs = eng.generate(
+            [[9, 8, 7, 6], [1, 2, 3, 4], [5, 5, 5, 5]],
+            SamplingParams(max_tokens=16, temperature=0.0))
+        configure_faults(None)
+        assert [o.finished for o in outs] == [True] * 3
+        assert all(len(o.output_token_ids) == 16 for o in outs)
+        kinds = eng.scheduler.num_preemptions_by_kind
+        assert kinds["recompute"] > 0, "pressure never preempted"
+        assert kinds["swap"] == 0, "a failed swap-out was counted as a swap"
+        assert not eng.scheduler.swapped
+        assert eng.swapper.host.num_in_use == 0
+        assert not eng.has_unfinished_requests()
+
+
 # --------------------------------------------------------------------------
 # Router chaos
 # --------------------------------------------------------------------------

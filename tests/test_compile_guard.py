@@ -40,10 +40,14 @@ def _engine():
 
 def _compiled_variants(eng) -> int:
     """Total jit-cache entries across every step program — the number of
-    distinct XLA compilations the load has triggered."""
+    distinct XLA compilations the load has triggered. Includes the two-tier
+    KV cache's swap gather/scatter programs when the host tier is on."""
     total = 0
-    for fn in (eng._prefill_fn, eng._prefill_hist_fn, eng._mixed_fn,
-               eng._decode_fn, eng._decode_fn_greedy, eng._spec_verify_fn):
+    fns = [eng._prefill_fn, eng._prefill_hist_fn, eng._mixed_fn,
+           eng._decode_fn, eng._decode_fn_greedy, eng._spec_verify_fn]
+    if eng.swapper is not None:
+        fns += [eng.swapper._gather_fn, eng.swapper._scatter_fn]
+    for fn in fns:
         if fn is not None and hasattr(fn, "_cache_size"):
             total += fn._cache_size()
     return total
@@ -147,3 +151,58 @@ def test_spec_load_compile_count_bounded():
     _run_spec_wave(eng, "w2")
     assert _compiled_variants(eng) == first, \
         "second identical spec wave triggered new XLA compilations"
+
+
+def _swap_engine():
+    """Page-starved pool + host tier: decode growth must preempt-by-swap
+    (and restore) during the wave, exercising the gather/scatter programs."""
+    # Mixing off: the swap path preempts inside _grow_decode_pages either
+    # way, and skipping the mixed program's compiles keeps this guard cheap
+    # (the mixed family's bound is test_mixed_load_compile_count_bounded).
+    cfg = EngineConfig(
+        model=get_model_config("debug-tiny"),
+        cache=CacheConfig(page_size=8, num_pages=13, swap_space_gb=0.01),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_prefill_tokens=32,
+            decode_buckets=DECODE_BUCKETS, prefill_buckets=PREFILL_BUCKETS,
+            decode_window=2, mixed_batch_enabled=False))
+    return LLMEngine(cfg)
+
+
+def _run_swap_wave(eng, tag: str) -> None:
+    rng = np.random.default_rng(3)
+    lengths = [12, 16, 10, 14]
+    params = SamplingParams(max_tokens=12, temperature=0.0)
+    for i, n in enumerate(lengths):
+        eng.add_request(f"{tag}-{i}", rng.integers(1, 500, n).tolist(),
+                        params)
+    while eng.has_unfinished_requests():
+        eng.step()
+
+
+def test_swap_load_compile_count_bounded():
+    """Swap gather/scatter add a BOUNDED compile family: page-count inputs
+    pad to powers of two, so each direction compiles at most
+    log2(max pages/seq)+1 variants — and a second identical swap wave
+    compiles NOTHING new (steady-state serving never recompiles for swap)."""
+    from kubernetes_gpu_cluster_tpu.utils.math import next_power_of_2
+
+    eng = _swap_engine()
+    _run_swap_wave(eng, "w1")
+    assert eng.scheduler.num_preemptions_by_kind["swap"] > 0, \
+        "simulation never exercised a swap preemption"
+    assert eng.obs.swap_pages["in"] > 0, "no swapped sequence was restored"
+    first = _compiled_variants(eng)
+    n_tp, n_rows = len(PREFILL_BUCKETS), len(DECODE_BUCKETS)
+    max_pages = eng.config.effective_max_len // 8
+    n_swap_sizes = int(np.log2(next_power_of_2(max_pages))) + 1
+    bound = (n_tp * n_rows          # pure prefill
+             + n_tp * n_rows * 3    # mixed
+             + n_tp * 3             # solo chunk
+             + n_rows * 2           # decode greedy/sampled
+             + 2 * n_swap_sizes)    # swap gather + scatter, pow-2 sizes
+    assert 0 < first <= bound, (first, bound)
+
+    _run_swap_wave(eng, "w2")
+    assert _compiled_variants(eng) == first, \
+        "second identical swap wave triggered new XLA compilations"

@@ -157,6 +157,23 @@ def test_spec_decode_knobs_map_to_engine_flags():
     assert "--num-speculative-tokens" not in args
 
 
+def test_swap_space_knob_maps_to_engine_flag():
+    """vllmConfig.swapSpaceGB renders to the API server's --swap-space-gb
+    (the two-tier KV cache's deployment surface, vLLM swapSpace parity);
+    absent renders nothing — swap stays off by default."""
+    values = copy.deepcopy(VALUES)
+    cfg = values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"]
+    cfg["swapSpaceGB"] = 4
+    ms = render_values(values)
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args[args.index("--swap-space-gb") + 1] == "4"
+    ms = render_values(copy.deepcopy(VALUES))
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--swap-space-gb" not in args
+
+
 def test_quantization_knobs_map_to_engine_flags():
     """vllmConfig.quantization / quantGroupSize render to the API server's
     --quantization / --quant-group-size (the weight-only quant ladder's

@@ -418,6 +418,53 @@ class TestQuantSurface:  # KGCT009
         assert list(rule.check(mod)) == []
 
 
+class TestSwapOrder:  # KGCT010
+    def test_release_before_gather_fires(self):
+        found = lint("""
+            def preempt(self, victim):
+                self._release(victim)
+                pages = self.swapper.swap_out(victim.pages)
+                victim.host_pages = pages
+        """, "KGCT010")
+        assert len(found) == 1 and "before the swap gather" in found[0].message
+
+    def test_allocator_free_before_spill_fires(self):
+        found = lint("""
+            def evict(self, page):
+                self.allocator.free([page])
+                return self.swapper.spill_page(page)
+        """, "KGCT010")
+        assert len(found) == 1
+
+    def test_gather_then_release_is_silent(self):
+        assert lint("""
+            def preempt(self, victim):
+                pages = self.swapper.swap_out(victim.pages)
+                self._release(victim)
+                victim.host_pages = pages
+        """, "KGCT010") == []
+
+    def test_release_only_and_host_free_silent(self):
+        # abort/finish paths release without gathering — out of scope
+        assert lint("""
+            def abort(self, seq):
+                self._release(seq)
+        """, "KGCT010") == []
+        # host-pool frees are not device releases
+        assert lint("""
+            def drop(self, page, hp):
+                self.swapper.free_host([hp])
+                return self.swapper.spill_page(page)
+        """, "KGCT010") == []
+
+    def test_outside_engine_out_of_scope(self):
+        assert lint("""
+            def preempt(self, victim):
+                self._release(victim)
+                return self.swapper.swap_out(victim.pages)
+        """, "KGCT010", relpath="serving/fake.py") == []
+
+
 class TestFramework:
     def test_every_rule_has_code_name_description(self):
         codes = [r.code for r in ALL_RULES]
