@@ -119,6 +119,26 @@ SWAP_BENCH = os.environ.get("KGCT_BENCH_SWAP", "1") != "0"
 SWAP_SESSIONS = int(os.environ.get("KGCT_BENCH_SWAP_SESSIONS", 8))
 SWAP_OVERSUB = float(os.environ.get("KGCT_BENCH_SWAP_OVERSUB", 2.0))
 SWAP_MAX_NEW = int(os.environ.get("KGCT_BENCH_SWAP_MAX_NEW", 48))
+# Router phase (serving/router.py prefix-affinity): a shared-prefix SESSION
+# workload replayed through the REAL router over >= 2 in-process engine
+# replicas, A/B least-inflight vs prefix-affinity on identically-seeded
+# engines. Least-inflight scatters a session's repeat requests across
+# replicas (each replica must re-prefill the shared prefix before its own
+# cache warms); affinity routes them to the ring owner whose cache is
+# already hot — the phase reports warm-request TTFT and per-replica
+# prefix-cache hit ratios for both arms. Always runs debug-tiny engines
+# (the phase measures ROUTING locality, not model speed, and on TPU the
+# primary config's pool must not be re-instantiated N more times).
+# KGCT_BENCH_ROUTER=0 skips.
+ROUTER_BENCH = os.environ.get("KGCT_BENCH_ROUTER", "1") != "0"
+ROUTER_REPLICAS = int(os.environ.get("KGCT_BENCH_ROUTER_REPLICAS", 2))
+# Sessions deliberately coprime with the replica count: least-inflight's
+# round-robin tie-break then alternates each session across replicas
+# (the scatter the affinity policy exists to fix); an equal multiple would
+# park session s on replica s % N by accident and hide the effect.
+ROUTER_SESSIONS = int(os.environ.get("KGCT_BENCH_ROUTER_SESSIONS",
+                                     ROUTER_REPLICAS + 1))
+ROUTER_ROUNDS = int(os.environ.get("KGCT_BENCH_ROUTER_ROUNDS", 3))
 
 # The stdout contract bench.py guarantees (also the --help epilog, and what
 # tests/test_bench_contract.py pins): everything before the last line is
@@ -809,6 +829,180 @@ def _measure_swap(model_name: str, quant, rng) -> dict:
     return out
 
 
+def _measure_router() -> dict:
+    """KGCT_BENCH_ROUTER phase: cache-aware fleet routing A/B through the
+    real serving stack — N in-process replicas (api_server.build_server on
+    real sockets, prefix caching on) behind serving/router.Router, replaying
+    a shared-prefix session workload:
+
+    - ROUTER_SESSIONS sessions, each with its own page-aligned shared
+      prefix; ROUTER_ROUNDS rounds issue one request per session
+      (prefix + a unique tail), sequentially — the steady inflight=0 state
+      where least-inflight's tie-break round-robins and scatters sessions.
+    - arm "least_inflight": the pre-affinity policy. A session's round-2
+      request lands on the OTHER replica (cold: full-prefix prefill).
+    - arm "prefix_affinity": bounded-load ring routing on the prompt
+      prefix — every round after the first lands on the owner replica
+      whose cache holds the prefix (warm: tail-only prefill).
+
+    Both arms run identically-seeded engines and identical prompts; each
+    replica is warmed DIRECTLY (bypassing the router) with a discarded
+    prefix-reuse pair so the full-prefill AND cached-history programs are
+    compiled everywhere before measurement (never time XLA compilation).
+    Headline: affinity warm-request TTFT p50 / least-inflight's, plus
+    per-replica prefix-cache hit ratios showing locality concentrate."""
+    import asyncio
+
+    import aiohttp
+    from aiohttp import web as aioweb
+
+    from kubernetes_gpu_cluster_tpu.serving.api_server import build_server
+    from kubernetes_gpu_cluster_tpu.serving.router import Router
+
+    on_tpu = jax.default_backend() == "tpu"
+    page = PAGE if PAGE is not None else (128 if on_tpu else 16)
+    shared_len = max(PROMPT_LEN // page, 1) * page
+    tail = 16
+    full_len = shared_len + tail
+    vocab_cap = 200
+    ladder = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+    top = next((b for b in ladder if b >= full_len), full_len)
+    buckets = tuple(b for b in ladder if b < full_len) + (top,)
+    pages_per_seq = cdiv(full_len + 4, page) + 1
+
+    def engine_config():
+        return EngineConfig(
+            model=get_model_config("debug-tiny"),
+            cache=CacheConfig(
+                page_size=page,
+                num_pages=(2 * (ROUTER_SESSIONS + 1) + 4) * pages_per_seq + 1),
+            scheduler=SchedulerConfig(
+                max_num_seqs=2, max_prefill_tokens=top,
+                decode_buckets=(1, 2), prefill_buckets=buckets,
+                decode_window=4, mixed_batch_enabled=False,
+                enable_prefix_caching=True))
+
+    def prompt_of(prefix_seed: int, tail_seed: int) -> list:
+        p_rng = np.random.default_rng(prefix_seed)
+        t_rng = np.random.default_rng(tail_seed)
+        return (p_rng.integers(1, vocab_cap, shared_len).tolist()
+                + t_rng.integers(1, vocab_cap, tail).tolist())
+
+    def scrape(text: str, name: str) -> float:
+        for line in text.splitlines():
+            if line.startswith(name + " "):
+                return float(line.rpartition(" ")[2])
+        return 0.0
+
+    async def run_arm(policy: str) -> dict:
+        runners, urls = [], []
+        for _ in range(ROUTER_REPLICAS):
+            srv = build_server(engine_config(), None, "debug-tiny")
+            runner = aioweb.AppRunner(srv.build_app())
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runners.append(runner)
+            urls.append(f"http://127.0.0.1:{runner.addresses[0][1]}")
+        # Affinity window clamped to the shared prefix: with a short
+        # KGCT_BENCH_PROMPT the default 32-token window would fold each
+        # request's UNIQUE tail into the key, silently un-sticking the
+        # sessions and reporting a misleading "affinity does not help".
+        router = Router(urls, health_interval_s=9999,
+                        routing_policy=policy,
+                        affinity_prefix_len=min(32, shared_len))
+        rrunner = aioweb.AppRunner(router.build_app())
+        await rrunner.setup()
+        rsite = aioweb.TCPSite(rrunner, "127.0.0.1", 0)
+        await rsite.start()
+        router_url = f"http://127.0.0.1:{rrunner.addresses[0][1]}"
+
+        out: dict = {"policy": policy}
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async def complete(base: str, prompt: list) -> float:
+                    t0 = time.perf_counter()
+                    async with sess.post(
+                            f"{base}/v1/completions",
+                            json={"prompt": prompt, "max_tokens": 1,
+                                  "temperature": 0.0}) as resp:
+                        assert resp.status == 200, await resp.text()
+                        await resp.read()
+                    return time.perf_counter() - t0
+
+                # Direct per-replica warmup (discarded): compiles full
+                # prefill, cached-history chunk, and decode everywhere.
+                for i, url in enumerate(urls):
+                    await complete(url, prompt_of(90_000 + i, 0))
+                    await complete(url, prompt_of(90_000 + i, 1))
+
+                before = []
+                for url in urls:
+                    async with sess.get(f"{url}/metrics") as resp:
+                        before.append(await resp.text())
+
+                cold, warm = [], []
+                for rnd in range(ROUTER_ROUNDS):
+                    for s in range(ROUTER_SESSIONS):
+                        dt = await complete(
+                            router_url,
+                            prompt_of(50_000 + s, 1000 * rnd + s))
+                        (cold if rnd == 0 else warm).append(dt)
+
+                per_replica = []
+                for i, url in enumerate(urls):
+                    async with sess.get(f"{url}/metrics") as resp:
+                        text = await resp.text()
+                    hits = (scrape(text, "kgct_prefix_cache_hits_total")
+                            - scrape(before[i],
+                                     "kgct_prefix_cache_hits_total"))
+                    misses = (scrape(text, "kgct_prefix_cache_misses_total")
+                              - scrape(before[i],
+                                       "kgct_prefix_cache_misses_total"))
+                    served = (scrape(text, "kgct_requests_total")
+                              - scrape(before[i], "kgct_requests_total"))
+                    per_replica.append({
+                        "requests": int(served),
+                        "cache_hits": int(hits),
+                        "cache_misses": int(misses),
+                        "hit_ratio": (round(hits / (hits + misses), 3)
+                                      if hits + misses else None),
+                    })
+                out.update({
+                    "ttft_cold_p50_ms": round(_median(cold) * 1e3, 1),
+                    "ttft_warm_p50_ms": round(_median(warm) * 1e3, 1),
+                    "per_replica": per_replica,
+                })
+                if policy == "prefix-affinity":
+                    reqs = router.affinity_requests_total
+                    out["affinity_hit_ratio"] = (
+                        round(router.affinity_hits_total / reqs, 3)
+                        if reqs else None)
+                    out["ring_remaps"] = router.ring_remaps_total
+        finally:
+            await rrunner.cleanup()
+            for runner in runners:
+                await runner.cleanup()
+        return out
+
+    out: dict = {
+        "replicas": ROUTER_REPLICAS,
+        "sessions": ROUTER_SESSIONS,
+        "rounds": ROUTER_ROUNDS,
+        "shared_prefix_tokens": shared_len,
+        "tail_tokens": tail,
+    }
+    for label, policy in (("least_inflight", "least-inflight"),
+                          ("prefix_affinity", "prefix-affinity")):
+        out[label] = asyncio.run(run_arm(policy))
+        gc.collect()
+    li, aff = out["least_inflight"], out["prefix_affinity"]
+    out["warm_ttft_ratio"] = (
+        round(aff["ttft_warm_p50_ms"] / li["ttft_warm_p50_ms"], 3)
+        if li["ttft_warm_p50_ms"] else None)
+    return out
+
+
 # --------------------------------------------------------------------------
 # Per-config driver
 # --------------------------------------------------------------------------
@@ -1027,6 +1221,11 @@ def assemble_output(results: list[dict], backend: str) -> dict:
         "swap_resume_over_recompute_ttft": (primary.get("kv_swap", {})
                                             .get("resume_ttft_ratio")),
         "preemptions": primary.get("kv_swap", {}).get("preemptions"),
+        # Fleet-routing phase headline: warm-request TTFT through the
+        # prefix-affinity router as a fraction of least-inflight's (full
+        # A/B block in configs[-1].router_affinity).
+        "router_affinity_warm_over_li_ttft": (
+            primary.get("router_affinity", {}).get("warm_ttft_ratio")),
         "configs": results,
     }
 
@@ -1084,7 +1283,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
             "oversubscribed session workload, swap-preemption vs "
             "recompute-preemption A/B, default on; 0=skip), "
             "KGCT_BENCH_SWAP_SESSIONS, KGCT_BENCH_SWAP_OVERSUB, "
-            "KGCT_BENCH_SWAP_MAX_NEW, KGCT_BENCH_PROMPT, KGCT_BENCH_PAGE, "
+            "KGCT_BENCH_SWAP_MAX_NEW, KGCT_BENCH_ROUTER (1=fleet-routing "
+            "phase: shared-prefix session workload through the real router "
+            "over in-process replicas, least-inflight vs prefix-affinity "
+            "A/B, default on; 0=skip), KGCT_BENCH_ROUTER_REPLICAS, "
+            "KGCT_BENCH_ROUTER_SESSIONS, KGCT_BENCH_ROUTER_ROUNDS, "
+            "KGCT_BENCH_PROMPT, KGCT_BENCH_PAGE, "
             "KGCT_CHIP_HBM_GBPS, KGCT_CHIP_TFLOPS_BF16. KGCT_BENCH_QUANT "
             "accepts int8 or int4 (the W4A16 dequant-fused path)."))
     return p
@@ -1096,6 +1300,7 @@ _DROPPABLE_HEADLINE = ("ttft_decomposition", "baseline_bar", "mixed_batch",
                        "sampled_over_greedy", "spec_acceptance_ratio",
                        "prefix_warm_over_cold_ttft",
                        "swap_resume_over_recompute_ttft", "preemptions",
+                       "router_affinity_warm_over_li_ttft",
                        "decode_window", "prefill_budget", "vs_baseline")
 
 
@@ -1219,6 +1424,10 @@ def main() -> None:
         primary = configs[-1]
         results[-1]["kv_swap"] = _measure_swap(
             primary["model_name"], primary.get("quant"), rng)
+    if ROUTER_BENCH:
+        # Fleet-routing phase: in-process multi-replica A/B through the
+        # real router (always debug-tiny engines; see _measure_router).
+        results[-1]["router_affinity"] = _measure_router()
     emit_result(assemble_output(results, backend))
 
 

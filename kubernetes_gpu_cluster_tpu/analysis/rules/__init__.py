@@ -14,6 +14,7 @@ from .asyncio_hygiene import AsyncioHygieneRule
 from .metric_hygiene import MetricHygieneRule
 from .logging_hygiene import LoggingHygieneRule
 from .quant_surface import QuantSurfaceRule
+from .router_pick import RouterPickPathRule
 from .swap_order import SwapOrderRule
 
 ALL_RULES = [
@@ -27,6 +28,7 @@ ALL_RULES = [
     LoggingHygieneRule(),
     QuantSurfaceRule(),
     SwapOrderRule(),
+    RouterPickPathRule(),
 ]
 
 

@@ -217,11 +217,40 @@ def _pod_spec(spec: dict, engine: dict, multihost: bool) -> dict:
     return pod
 
 
-def _render_model(spec: dict, engine: dict) -> dict[str, dict]:
+def _is_multihost(spec: dict) -> bool:
+    """One StatefulSet-of-ranks pod group (vs N independent replica pods).
+    The ONE definition: the workload-kind choice in _render_model and the
+    router-addressing choice in _replica_urls must always agree, or the
+    router would resolve per-pod DNS names a different workload kind never
+    creates."""
+    cfg = spec.get("vllmConfig") or {}
+    return bool(spec.get("raySpec")) or cfg.get("pipelineParallelSize", 1) > 1
+
+
+def _replica_urls(spec: dict, affinity: bool) -> list[str]:
+    """The router's view of one modelSpec: either the model's Service (one
+    URL; kube-proxy balances across pods behind it) or — in prefix-affinity
+    mode, where kube-proxy's random pod choice would scatter a session's
+    requests and destroy the cache locality the ring exists to protect —
+    one stable per-pod DNS name per replica (StatefulSet + headless
+    Service), so the hash ring owns individual pods."""
+    name = spec["name"]
+    if not affinity or _is_multihost(spec):
+        # Multihost keeps its rank-0 Service even under affinity: client
+        # traffic must only reach rank 0 (it drives the global-mesh step),
+        # so the group IS one routing target.
+        return [f"http://kgct-{name}-engine-svc:{ENGINE_PORT}"]
+    return [f"http://kgct-{name}-engine-{i}.kgct-{name}-engine-hl:"
+            f"{ENGINE_PORT}"
+            for i in range(int(spec.get("replicaCount", 1)))]
+
+
+def _render_model(spec: dict, engine: dict,
+                  affinity: bool = False) -> dict[str, dict]:
     """One modelSpec entry -> its manifests {filename: manifest}."""
     name = spec["name"]
     cfg = spec.get("vllmConfig") or {}
-    multihost = bool(spec.get("raySpec")) or cfg.get("pipelineParallelSize", 1) > 1
+    multihost = _is_multihost(spec)
     labels = _labels(name, "serving-engine")
     sel = {"matchLabels": labels}
     meta = {"name": f"kgct-{name}-engine", "labels": labels}
@@ -263,6 +292,38 @@ def _render_model(spec: dict, engine: dict) -> dict[str, dict]:
                 ],
             },
         }
+    elif affinity:
+        # Prefix-affinity routing needs STABLE per-replica addresses (the
+        # ring maps keys to pods, and a key must keep resolving to the same
+        # pod across router restarts and peer churn): a StatefulSet gives
+        # each replica the DNS identity kgct-<name>-engine-<i>.<headless>,
+        # which _replica_urls enumerates into the router's --replicas.
+        out[f"{name}-engine-statefulset.yaml"] = {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": meta,
+            "spec": {
+                "serviceName": f"kgct-{name}-engine-hl",
+                "replicas": spec.get("replicaCount", 1),
+                "podManagementPolicy": "Parallel",
+                "selector": sel,
+                "template": pod,
+            },
+        }
+        out[f"{name}-engine-headless-svc.yaml"] = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": f"kgct-{name}-engine-hl", "labels": labels},
+            "spec": {
+                "clusterIP": "None",
+                # The router runs its own health probes and circuit
+                # breaking; per-pod DNS must resolve from the moment the
+                # pod exists so the startup probe can find it.
+                "publishNotReadyAddresses": True,
+                "selector": labels,
+                "ports": [{"name": "http", "port": ENGINE_PORT}],
+            },
+        }
     else:
         out[f"{name}-engine-deployment.yaml"] = {
             "apiVersion": "apps/v1",
@@ -295,10 +356,19 @@ def _render_model(spec: dict, engine: dict) -> dict[str, dict]:
     return out
 
 
-def _render_router(model_names: list[str], router_spec: dict) -> dict[str, dict]:
+def _render_router(replica_urls: list[str], router_spec: dict,
+                   routing: Optional[dict] = None) -> dict[str, dict]:
     labels = _labels("router", "router")
-    replicas = ",".join(
-        f"http://kgct-{n}-engine-svc:{ENGINE_PORT}" for n in model_names)
+    replicas = ",".join(replica_urls)
+    routing = routing or {}
+    policy_args: list[str] = []
+    if routing.get("policy"):
+        policy_args += ["--routing-policy", str(routing["policy"])]
+    if routing.get("affinityPrefixLen") is not None:
+        policy_args += ["--affinity-prefix-len",
+                        str(routing["affinityPrefixLen"])]
+    if routing.get("balanceFactor") is not None:
+        policy_args += ["--balance-factor", str(routing["balanceFactor"])]
     return {
         "router-deployment.yaml": {
             "apiVersion": "apps/v1",
@@ -323,7 +393,7 @@ def _render_router(model_names: list[str], router_spec: dict) -> dict[str, dict]
                         "command": ["python", "-m",
                                     "kubernetes_gpu_cluster_tpu.serving.router"],
                         "args": ["--replicas", replicas,
-                                 "--port", str(ROUTER_PORT)],
+                                 "--port", str(ROUTER_PORT)] + policy_args,
                         "ports": [{"containerPort": ROUTER_PORT}],
                         "readinessProbe": {
                             "httpGet": {"path": "/health",
@@ -411,14 +481,60 @@ def render_values(values: dict) -> dict[str, dict]:
         "image": engine_spec.get("image", DEFAULT_IMAGE),
         "runtimeClassName": engine_spec.get("runtimeClassName") or None,
     }
+    # Routing policy knobs: routerSpec is the natural home (the router owns
+    # the policy); vllmConfig.routingPolicy is the values-schema-compatible
+    # spelling (the reference kept every serving knob under vllmConfig) and
+    # is honored on ANY modelSpec — there is one router, so two specs
+    # naming different policies is a contradiction that fails the RENDER,
+    # as does an unknown policy anywhere (never the router pod at start).
+    router_spec = values.get("routerSpec") or {}
+    spec_policies = {p for p in
+                     ((s.get("vllmConfig") or {}).get("routingPolicy")
+                      for s in specs) if p is not None}
+    for policy in spec_policies | {router_spec.get("routingPolicy")}:
+        if policy not in (None, "least-inflight", "prefix-affinity"):
+            raise ValueError(
+                f"routingPolicy {policy!r} is not a known policy "
+                "(known: least-inflight, prefix-affinity)")
+    if len(spec_policies) > 1:
+        raise ValueError(
+            "conflicting vllmConfig.routingPolicy values across modelSpec "
+            f"entries ({', '.join(sorted(spec_policies))}): the stack has "
+            "ONE router — set the policy once (routerSpec.routingPolicy)")
+    router_policy = router_spec.get("routingPolicy")
+    if (router_policy and spec_policies
+            and spec_policies != {router_policy}):
+        # Same contradiction, spelled across layers: silently letting one
+        # side win would deploy a router the OTHER side believes is
+        # cache-affine (or believes is not).
+        raise ValueError(
+            f"routerSpec.routingPolicy {router_policy!r} contradicts "
+            f"vllmConfig.routingPolicy {spec_policies.pop()!r} — the stack "
+            "has ONE router; set the policy in one place")
+    cfg_knobs = [s.get("vllmConfig") or {} for s in specs]
+
+    def knob(name):
+        if router_spec.get(name) is not None:
+            return router_spec[name]
+        return next((c[name] for c in cfg_knobs
+                     if c.get(name) is not None), None)
+
+    routing = {
+        "policy": (router_spec.get("routingPolicy")
+                   or (spec_policies.pop() if spec_policies else None)),
+        "affinityPrefixLen": knob("affinityPrefixLen"),
+        "balanceFactor": knob("balanceFactor"),
+    }
+    affinity = routing["policy"] == "prefix-affinity"
     out: dict[str, dict] = {}
+    replica_urls: list[str] = []
     for spec in specs:
         if not spec.get("name"):
             raise ValueError("modelSpec entry missing 'name'")
         _validate_model_url(spec)
-        out.update(_render_model(spec, engine))
-    out.update(_render_router([s["name"] for s in specs],
-                              values.get("routerSpec") or {}))
+        out.update(_render_model(spec, engine, affinity=affinity))
+        replica_urls.extend(_replica_urls(spec, affinity))
+    out.update(_render_router(replica_urls, router_spec, routing))
     return out
 
 

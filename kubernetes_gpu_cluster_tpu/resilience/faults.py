@@ -19,6 +19,9 @@ point (grep for ``inject(`` / ``fault_value(``):
                        failure -> bounded-backoff failover path)
 - ``replica_hang``     router: upstream stream read raises a simulated
                        read-timeout (stalled replica -> circuit break)
+- ``replica_down``     router: the health probe of replica index ``value``
+                       is forced to fail (drained/dead replica -> its
+                       ring-owned keys remap to the ring successor)
 - ``step_stall``       engine: step() sleeps ``delay`` seconds (hung device
                        dispatch -> watchdog trip)
 - ``broadcast_fail``   multihost leader: directive broadcast raises

@@ -14,6 +14,11 @@ The reference's user-facing contract: an OpenAI API served behind
                                   (Chrome/Perfetto trace-event JSON)
 - ``POST /debug/profile``         jax.profiler capture of live traffic
 
+Completion bodies may carry ``session_id`` (or OpenAI's ``user``) — scalar
+affinity keys the prefix-affinity router (serving/router.py) peeks at to
+keep a session's requests on the replica holding its warm KV pages. The
+engine validates the type (400 on non-scalars) and otherwise ignores them.
+
 Stop semantics: stop TOKEN ids fire inside the engine; stop STRINGS are
 evaluated here on incrementally detokenized text (IncrementalDetokenizer
 holds back a potential partial match, then the request is aborted
@@ -320,6 +325,19 @@ class APIServer:
         gate = self._admission_gate(request)
         if gate is not None:
             return gate
+        # Session/user passthrough (the router's affinity keys): accepted on
+        # every completion body so clients can pin a session to one replica
+        # via the prefix-affinity router. Validated here — a non-scalar
+        # value would silently change the ROUTER's hashing semantics per
+        # request, so it is a loud 400 at the engine, the layer that owns
+        # body validation. ``user`` is OpenAI's own field; ``session_id``
+        # is the explicit spelling that wins precedence at the router.
+        for field in ("session_id", "user"):
+            val = body.get(field)
+            if val is not None and (isinstance(val, bool)
+                                    or not isinstance(val, (str, int))):
+                return _error(400, f"{field} must be a string or integer "
+                                   "(routing affinity key)")
         n_lp, lp_err = _logprobs_requested(body)
         if lp_err is not None:
             return lp_err

@@ -9,34 +9,68 @@ equivalent: an aiohttp reverse proxy that
 - tracks replica health (probed immediately at startup, then periodic GET
   /health; unhealthy replicas leave the rotation and return on recovery —
   the k8s-native restart/rollout story of SURVEY §5.3 at the traffic layer),
-- balances by least-outstanding-requests (better than round-robin under
-  continuous batching: a replica stuck on long generations accumulates
-  in-flight count and sheds new work),
+- balances by one of two policies (``--routing-policy``):
+
+  * ``least-inflight`` (default): least-outstanding-requests — better than
+    round-robin under continuous batching (a replica stuck on long
+    generations accumulates in-flight count and sheds new work), but it
+    scatters a session's requests across replicas, destroying the engine-
+    side prefix-cache hits that collapse warm TTFT;
+  * ``prefix-affinity``: bounded-load consistent hashing (CHWBL) keyed on
+    the request's prompt prefix — the first ``affinity_prefix_len`` tokens'
+    bytes, or an explicit ``session_id``/``user`` field when the body
+    carries one. The key hashes onto a replica ring with virtual nodes;
+    the ring owner serves it unless admitting one more request would push
+    it past ``ceil(balance_factor * (total_inflight + 1) / n_replicas)``,
+    in which case the walk continues to the next under-bound replica — hot
+    prefixes still spread, cold traffic never evicts a warm replica's
+    cache. Unhealthy/benched/excluded replicas are skipped on the same
+    walk, so membership churn remaps only the dead replica's keys
+    (~K/N of K keys, the consistent-hashing contract) and every key's
+    assignment is deterministic across router restarts (hashes come from
+    :mod:`hashlib`, never the process-salted builtin ``hash``). When no
+    affinity key can be derived (GET /v1/models, unparseable body) or
+    every ring candidate is over-bound, the pick degrades to
+    least-inflight over the same candidates — never a 5xx,
+
 - streams responses through unbuffered (SSE passthrough),
 - hardens every upstream call: per-attempt connect timeouts, a per-read
   stall timeout that circuit-breaks replicas whose in-flight streams hang,
   and bounded exponential-backoff retry of connect-phase failures (the only
   phase where nothing reached the upstream, so re-sending is safe).
 
+Every pick path — first attempt, connect-phase retry-with-exclude, the
+desperation rounds over benched replicas — flows through the single
+``_pick`` seam (pinned by the KGCT011 lint rule), so both policies inherit
+the circuit-breaking/retry machinery unchanged.
+
 Chaos sites (resilience.faults): ``router_connect`` simulates a connect
-failure on the picked replica, ``replica_hang`` a mid-stream read timeout.
+failure on the picked replica, ``replica_hang`` a mid-stream read timeout,
+``replica_down`` forces the health probe of replica index ``value`` to
+fail (drain/death remap of ring-owned keys).
 
 In-cluster, replica discovery is the headless-Service DNS name; static URLs
 work for local/dev. Deployment manifests are rendered by
-kubernetes_gpu_cluster_tpu.deploy (router Deployment + kgct-router-service).
+kubernetes_gpu_cluster_tpu.deploy (router Deployment + kgct-router-service;
+``prefix-affinity`` renders single-host models as StatefulSets so every
+replica pod has a stable DNS name the ring can own).
 """
 
 from __future__ import annotations
 
 import asyncio
 import contextlib
-import itertools
+import bisect
+import hashlib
+import json
+import math
 import time
 from typing import Optional
 
 import aiohttp
 from aiohttp import web
 
+from ..resilience.faults import get_injector as _get_injector
 from ..resilience.faults import inject as _inject_fault
 from ..utils import get_logger
 # The engine's shed/drain responses use the same envelope (serving.errors):
@@ -57,6 +91,62 @@ if hasattr(aiohttp, "ConnectionTimeoutError"):
 
 HOP_HEADERS = {"transfer-encoding", "content-length", "connection",
                "keep-alive", "host"}
+
+# Virtual nodes per replica on the consistent-hash ring. 64 points keep the
+# per-replica share of RAW key space within ~1.6x fair at small N (pinned by
+# the balance property test) while the ring stays tiny (N*64 bisect points);
+# the CHWBL load bound — not vnode count — is what bounds actual load skew.
+RING_VNODES = 64
+
+
+def _stable_hash(data: bytes) -> int:
+    """Ring/key hash: process-stable and platform-stable. The builtin
+    ``hash`` is salted per process (PYTHONHASHSEED), which would silently
+    give every router restart a different ring — the exact nondeterminism
+    the affinity contract forbids. blake2b is the fastest stdlib digest."""
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(),
+                          "big")
+
+
+class HashRing:
+    """Consistent-hash ring over replica URLs with virtual nodes.
+
+    Membership is fixed at construction (the rendered replica set); health
+    churn is handled by the CALLER skipping dead entries while walking from
+    the owner — equivalent to removing them from the ring (each dead
+    replica's keys land on their ring successors; everyone else's keys do
+    not move), without rebuild races. Identical URL lists produce identical
+    rings in any process (see :func:`_stable_hash`)."""
+
+    def __init__(self, urls: list[str], vnodes: int = RING_VNODES):
+        points: list[tuple[int, str]] = []
+        for url in urls:
+            for v in range(vnodes):
+                points.append((_stable_hash(f"{url}#{v}".encode()), url))
+        points.sort()
+        self._points = [p for p, _ in points]
+        self._urls = [u for _, u in points]
+
+    def owner(self, key: bytes) -> str:
+        """The ring owner of ``key`` (ignores health — the metrics notion
+        of 'where this key lives when everything is up')."""
+        return self._urls[self._index(key)]
+
+    def walk(self, key: bytes):
+        """Yield member URLs in ring order starting at ``key``'s owner,
+        each member once — the deterministic failover/overflow order."""
+        start = self._index(key)
+        seen: set[str] = set()
+        n = len(self._urls)
+        for i in range(n):
+            url = self._urls[(start + i) % n]
+            if url not in seen:
+                seen.add(url)
+                yield url
+
+    def _index(self, key: bytes) -> int:
+        i = bisect.bisect_right(self._points, _stable_hash(key))
+        return i % len(self._points)
 
 
 class Replica:
@@ -83,8 +173,33 @@ class Router:
                  metrics_timeout_s: float = 2.0,
                  connect_retries: int = 2,
                  retry_backoff_s: float = 0.25,
-                 bench_cooldown_s: float = 30.0):
+                 bench_cooldown_s: float = 30.0,
+                 routing_policy: str = "least-inflight",
+                 affinity_prefix_len: int = 32,
+                 balance_factor: float = 1.5,
+                 ring_vnodes: int = RING_VNODES):
+        if routing_policy not in ("least-inflight", "prefix-affinity"):
+            raise ValueError(f"unknown routing_policy {routing_policy!r} "
+                             "(known: least-inflight, prefix-affinity)")
+        if balance_factor < 1.0:
+            # c < 1 would bound every replica below the fair share and the
+            # walk could never place anything once traffic flows.
+            raise ValueError(f"balance_factor {balance_factor} must be >= 1")
         self.replicas = [Replica(u) for u in replica_urls]
+        self.routing_policy = routing_policy
+        self.affinity_prefix_len = affinity_prefix_len
+        self.balance_factor = balance_factor
+        self.ring = HashRing([r.url for r in self.replicas],
+                             vnodes=ring_vnodes)
+        # Affinity accounting (rendered on /metrics): a pick is a "hit" when
+        # the key landed on its ring owner, an "overflow" (labeled by the
+        # owner that was over-bound) when the bounded-load walk moved past
+        # it, and a "remap" when the owner was out of rotation entirely.
+        self.affinity_requests_total = 0
+        self.affinity_hits_total = 0
+        self.ring_remaps_total = 0
+        self.affinity_overflow_total: dict[str, int] = {
+            r.url: 0 for r in self.replicas}
         self.health_interval_s = health_interval_s
         self.fail_threshold = fail_threshold
         self.connect_timeout_s = connect_timeout_s
@@ -106,7 +221,12 @@ class Router:
         self.bench_cooldown_s = bench_cooldown_s
         self.retries_total = 0
         self.scrape_errors_total = 0
-        self._rr = itertools.count()
+        # Tied-least-inflight tie-break: a plain counter starting at 0, so
+        # the choice is a pure function of (config, pick sequence) — two
+        # routers replaying the same request sequence pick identically, and
+        # chaos replays reproduce (the old shared itertools.count iterator
+        # had the same values but no seam to assert or reset around).
+        self._pick_seq = 0
         self._session: Optional[aiohttp.ClientSession] = None
         self._health_task: Optional[asyncio.Task] = None
 
@@ -162,6 +282,23 @@ class Router:
                 ok = resp.status == 200
         except Exception:
             ok = False
+        # Chaos site replica_down: force the probe of replica index
+        # ``value`` to fail — the deterministic drain/death simulation the
+        # ring-remap chaos test replays (requests owned by the downed
+        # replica must move to its ring successor and move back on
+        # recovery). The rule's fire budget (after/times/p) is consumed
+        # ONLY by the targeted replica's probes: a plain fault_value() here
+        # would let every OTHER replica's probe burn the budget first and
+        # silently never down the intended one.
+        injector = _get_injector()
+        if injector is not None:
+            rule = injector.rules.get("replica_down")
+            if (rule is not None
+                    and self.replicas.index(replica) == int(rule.value)
+                    and rule.should_fire()):
+                logger.warning("KGCT_FAULT replica_down: probe of %s "
+                               "forced down", replica.url)
+                ok = False
         if ok:
             if time.monotonic() < replica.benched_until:
                 # Benched by TRAFFIC failures: a 200 probe proves only that
@@ -204,19 +341,72 @@ class Router:
                   f"{r.inflight}" for r in self.replicas]
         lines += ["# TYPE kgct_router_retries_total counter",
                   f"kgct_router_retries_total {self.retries_total}"]
+        # Routing-policy surface: which policy is live (info-style gauge)
+        # plus the affinity accounting. All zeros-safe — a fresh scrape of a
+        # least-inflight router renders every series with 0, never nan/absent
+        # (dashboards need no existence check).
+        reqs = self.affinity_requests_total
+        lines += [
+            "# TYPE kgct_router_policy gauge",
+            f'kgct_router_policy{{policy="{self.routing_policy}"}} 1',
+            "# TYPE kgct_router_affinity_requests_total counter",
+            f"kgct_router_affinity_requests_total {reqs}",
+            "# TYPE kgct_router_affinity_hits_total counter",
+            f"kgct_router_affinity_hits_total {self.affinity_hits_total}",
+            "# TYPE kgct_router_affinity_hit_ratio gauge",
+            "kgct_router_affinity_hit_ratio "
+            f"{self.affinity_hits_total / reqs if reqs else 0.0}",
+            "# TYPE kgct_router_ring_remaps_total counter",
+            f"kgct_router_ring_remaps_total {self.ring_remaps_total}",
+            "# TYPE kgct_router_affinity_overflow_total counter",
+        ]
+        lines += [f'kgct_router_affinity_overflow_total{{replica="{r.url}"}} '
+                  f"{self.affinity_overflow_total.get(r.url, 0)}"
+                  for r in self.replicas]
         # Aggregate each healthy replica's engine metrics behind the single
         # front door (one scrape target for the whole DP group), labelled by
         # replica so series do not collide. Each per-replica fetch is bounded
         # (metrics_timeout_s): one stalled replica must not hang the whole
         # scrape — stragglers are skipped and counted instead.
+        scraped = [r for r in self.replicas if r.healthy]
         fetched = await asyncio.gather(
-            *(self._fetch_metrics(r) for r in self.replicas if r.healthy),
+            *(self._fetch_metrics(r) for r in scraped),
             return_exceptions=True)
         self.scrape_errors_total += sum(
             1 for res in fetched if isinstance(res, BaseException))
         lines += ["# TYPE kgct_router_metrics_scrape_errors_total counter",
                   "kgct_router_metrics_scrape_errors_total "
                   f"{self.scrape_errors_total}"]
+        # Fleet locality readout: fold each replica's scraped prefix-cache
+        # hit ratio and swapped-sequence count into router-OWNED labeled
+        # gauges, so "is affinity concentrating locality" is one scrape of
+        # one target. Zeros/absent-safe: every replica gets a sample — 0.0
+        # when it is unhealthy, was skipped as a straggler, or its engine
+        # predates the series — a fresh scrape is nan-free by construction.
+        locality = {r.url: {"kgct_prefix_cache_hit_ratio": 0.0,
+                            "kgct_num_swapped": 0.0}
+                    for r in self.replicas}
+        for replica, res in zip(scraped, fetched):
+            if isinstance(res, BaseException):
+                continue
+            for family, is_type, line in res:
+                if is_type or family not in ("kgct_prefix_cache_hit_ratio",
+                                             "kgct_num_swapped"):
+                    continue
+                base = line.partition("{")[0]
+                if base not in locality[replica.url]:
+                    continue    # histogram-style child of another family
+                try:
+                    locality[replica.url][base] = float(line.rpartition(
+                        " ")[2])
+                except ValueError:
+                    pass        # malformed upstream sample: keep the zero
+        for name in ("kgct_prefix_cache_hit_ratio", "kgct_num_swapped"):
+            lines.append(f"# TYPE kgct_router_replica_{name.removeprefix('kgct_')} gauge")
+            lines += [
+                f'kgct_router_replica_{name.removeprefix("kgct_")}'
+                f'{{replica="{r.url}"}} {locality[r.url][name]}'
+                for r in self.replicas]
         # Regroup by metric family: the text exposition format requires ONE
         # TYPE line per family with ALL its samples contiguous — appending
         # replicas' expositions sequentially interleaves families and strict
@@ -275,15 +465,105 @@ class Router:
     # -- proxying ------------------------------------------------------------
 
     def _pick(self, exclude: Optional[set] = None,
-              include_unhealthy: bool = False) -> Optional[Replica]:
+              include_unhealthy: bool = False,
+              affinity_key: Optional[bytes] = None) -> Optional[Replica]:
+        """The ONE replica-selection seam (every proxy attempt, including
+        retry-with-exclude and desperation rounds, calls here — KGCT011).
+
+        ``affinity_key`` engages the prefix-affinity policy: walk the ring
+        from the key's owner, skipping out-of-rotation replicas, and take
+        the first whose load stays inside the CHWBL bound
+        ``ceil(balance_factor * (total_inflight + 1) / n_candidates)``.
+        All-over-bound (a bound < 1 is impossible, so this means real
+        saturation) falls through to least-inflight over the same
+        candidates — the policy degrades, it never refuses."""
         healthy = [r for r in self.replicas
                    if (r.healthy or include_unhealthy)
                    and (not exclude or r.url not in exclude)]
         if not healthy:
             return None
+        if (affinity_key is not None
+                and self.routing_policy == "prefix-affinity"):
+            candidates = {r.url: r for r in healthy}
+            bound = math.ceil(
+                self.balance_factor
+                * (sum(r.inflight for r in healthy) + 1) / len(healthy))
+            owner_url = self.ring.owner(affinity_key)
+            self.affinity_requests_total += 1
+            if owner_url not in candidates:
+                # Owner unhealthy/benched/excluded: its keys remap to ring
+                # successors until it returns (deterministic, and only ITS
+                # keys move).
+                self.ring_remaps_total += 1
+            for url in self.ring.walk(affinity_key):
+                replica = candidates.get(url)
+                if replica is None:
+                    continue
+                if replica.inflight + 1 <= bound:
+                    if url == owner_url:
+                        self.affinity_hits_total += 1
+                    elif owner_url in candidates:
+                        # Owner was available but over-bound: the hot-key
+                        # spillover the balance factor exists to allow.
+                        self.affinity_overflow_total[owner_url] = (
+                            self.affinity_overflow_total.get(owner_url, 0)
+                            + 1)
+                    return replica
+            # Every candidate over-bound: saturation, not a routing failure.
         least = min(r.inflight for r in healthy)
         tied = [r for r in healthy if r.inflight == least]
-        return tied[next(self._rr) % len(tied)]
+        seq = self._pick_seq
+        self._pick_seq += 1
+        return tied[seq % len(tied)]
+
+    def _affinity_key(self, body: bytes) -> Optional[bytes]:
+        """Derive the routing key from an already-buffered request body —
+        the proxy reads the full body before forwarding anyway (it may
+        re-send it on connect-phase failover), so the peek adds no latency
+        and never touches the response streaming path.
+
+        Precedence: explicit stickiness (``session_id``, then OpenAI's
+        ``user``) beats the prompt prefix — a session's later turns carry a
+        GROWING prompt, and only the explicit id keeps them on the replica
+        whose cache holds the earlier turns. Prompt prefix: the first
+        ``affinity_prefix_len`` ids of a token-array prompt, or the first
+        ``4 * affinity_prefix_len`` UTF-8 bytes of a text prompt / chat
+        messages serialization (~4 bytes per token, so both spellings key
+        on a comparable prefix window). None (no key derivable) routes
+        least-inflight."""
+        if self.routing_policy != "prefix-affinity" or not body:
+            return None
+        try:
+            obj = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not isinstance(obj, dict):
+            return None
+        for field in ("session_id", "user"):
+            val = obj.get(field)
+            if isinstance(val, (str, int)) and not isinstance(val, bool) \
+                    and val != "":
+                return f"sticky:{field}:{val}".encode()
+        text_window = 4 * self.affinity_prefix_len
+        prompt = obj.get("prompt")
+        if isinstance(prompt, str):
+            return b"text:" + prompt.encode("utf-8")[:text_window]
+        if isinstance(prompt, list) and prompt:
+            if len(prompt) == 1 and isinstance(prompt[0], str):
+                return b"text:" + prompt[0].encode("utf-8")[:text_window]
+            if all(isinstance(t, int) for t in
+                   prompt[:self.affinity_prefix_len]):
+                ids = ",".join(str(t)
+                               for t in prompt[:self.affinity_prefix_len])
+                return f"tokens:{ids}".encode()
+        messages = obj.get("messages")
+        if isinstance(messages, list) and messages:
+            try:
+                ser = json.dumps(messages, sort_keys=True)
+            except (TypeError, ValueError):
+                return None
+            return b"chat:" + ser.encode("utf-8")[:text_window]
+        return None
 
     async def proxy(self, request: web.Request) -> web.StreamResponse:
         """Reverse-proxy with failover.
@@ -299,6 +579,7 @@ class Router:
         (truncation is the signal) and the stall/death circuit-breaks the
         replica. Client-side disconnects never count against the replica."""
         body = await request.read()
+        akey = self._affinity_key(body)
         tried: set[str] = set()
         last_err: Optional[Exception] = None
         connect_failed = False
@@ -311,7 +592,8 @@ class Router:
             # riding out a restart blip. Nothing reached any upstream, so a
             # desperation probe of benched replicas is safe.
             replica = self._pick(exclude=tried,
-                                 include_unhealthy=rounds > 0)
+                                 include_unhealthy=rounds > 0,
+                                 affinity_key=akey)
             if replica is None:
                 # Every candidate this round failed at connect: nothing was
                 # sent anywhere, so a bounded backed-off re-probe of the
@@ -430,8 +712,27 @@ def main(argv: Optional[list[str]] = None) -> None:
                    help="comma-separated replica base URLs")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--routing-policy", default="least-inflight",
+                   choices=["least-inflight", "prefix-affinity"],
+                   help="least-inflight: fewest outstanding requests wins "
+                   "(the pre-affinity behavior, default). prefix-affinity: "
+                   "bounded-load consistent hashing on the prompt prefix / "
+                   "session_id so repeat traffic lands on the replica whose "
+                   "prefix cache is warm")
+    p.add_argument("--affinity-prefix-len", type=int, default=32,
+                   help="prefix-affinity: tokens of prompt prefix hashed "
+                   "into the routing key (token-array prompts use this many "
+                   "ids; text prompts use 4x this many UTF-8 bytes)")
+    p.add_argument("--balance-factor", type=float, default=1.5,
+                   help="prefix-affinity: CHWBL load bound — a ring owner "
+                   "above ceil(factor * mean inflight) spills the request "
+                   "to its ring successor (1.0 = strict fair share; larger "
+                   "= stickier)")
     args = p.parse_args(argv)
-    router = Router(args.replicas.split(","))
+    router = Router(args.replicas.split(","),
+                    routing_policy=args.routing_policy,
+                    affinity_prefix_len=args.affinity_prefix_len,
+                    balance_factor=args.balance_factor)
     web.run_app(router.build_app(), host=args.host, port=args.port)
 
 

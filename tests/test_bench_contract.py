@@ -264,3 +264,37 @@ class TestPrefixReuseContract:
     def test_absent_phase_yields_null_headline(self):
         out = bench.assemble_output(_fake_results(), "cpu")
         assert out["prefix_warm_over_cold_ttft"] is None
+
+
+class TestRouterPhaseContract:
+    """KGCT_BENCH_ROUTER rides the bounded last-line contract like the
+    other phases: headline parseable from the last stdout line, droppable
+    under the byte bound, null when the phase was skipped."""
+
+    def test_headline_parses_in_last_line(self):
+        results = _fake_results()
+        results[-1]["router_affinity"] = {
+            "replicas": 2, "sessions": 3, "rounds": 3,
+            "least_inflight": {"ttft_warm_p50_ms": 15.2,
+                               "per_replica": [{"hit_ratio": 0.4}]},
+            "prefix_affinity": {"ttft_warm_p50_ms": 11.3,
+                                "affinity_hit_ratio": 1.0,
+                                "per_replica": [{"hit_ratio": 0.667}]},
+            "warm_ttft_ratio": 0.743,
+        }
+        out = bench.assemble_output(results, "cpu")
+        parsed = bench.parse_result_line(json.dumps(out) + "\n")
+        assert parsed["router_affinity_warm_over_li_ttft"] == 0.743
+        assert (parsed["configs"][-1]["router_affinity"]["prefix_affinity"]
+                ["affinity_hit_ratio"]) == 1.0
+
+    def test_headline_is_droppable_under_the_bound(self):
+        assert ("router_affinity_warm_over_li_ttft"
+                in bench._DROPPABLE_HEADLINE)
+        out = bench.assemble_output(_fake_results(), "cpu")
+        line = json.dumps(bench.compact_result(out))
+        assert len(line) <= bench.RESULT_LINE_MAX
+
+    def test_absent_phase_yields_null_headline(self):
+        out = bench.assemble_output(_fake_results(), "cpu")
+        assert out["router_affinity_warm_over_li_ttft"] is None

@@ -220,9 +220,123 @@ def test_router_fronts_models():
     args = router["spec"]["template"]["spec"]["containers"][0]["args"]
     replicas = args[args.index("--replicas") + 1]
     assert replicas == "http://kgct-qwen3-engine-svc:8000"
+    # Default policy renders NO routing flags: the router's own
+    # least-inflight default applies and pre-affinity manifests are
+    # byte-stable.
+    assert "--routing-policy" not in args
+    assert "--affinity-prefix-len" not in args
+    assert "--balance-factor" not in args
     svc = ms["router-svc.yaml"]
     assert svc["metadata"]["name"] == "kgct-router-service"
     assert svc["spec"]["ports"][0]["port"] == 80
+
+
+def test_routing_policy_knobs_render_to_router_args():
+    """routerSpec routing knobs (and the values-schema-compatible
+    vllmConfig.routingPolicy spelling) render end-to-end into the router
+    Deployment's args; unknown policies fail the RENDER."""
+    values = copy.deepcopy(VALUES)
+    values["routerSpec"] = {"routingPolicy": "prefix-affinity",
+                            "affinityPrefixLen": 48, "balanceFactor": 1.25}
+    ms = render_values(values)
+    args = ms["router-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args[args.index("--routing-policy") + 1] == "prefix-affinity"
+    assert args[args.index("--affinity-prefix-len") + 1] == "48"
+    assert args[args.index("--balance-factor") + 1] == "1.25"
+    # vllmConfig spelling on the first modelSpec works too
+    values = copy.deepcopy(VALUES)
+    values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"][
+        "routingPolicy"] = "prefix-affinity"
+    ms = render_values(values)
+    args = ms["router-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args[args.index("--routing-policy") + 1] == "prefix-affinity"
+    # explicit least-inflight renders the flag (operator pinned it)
+    values = copy.deepcopy(VALUES)
+    values["routerSpec"] = {"routingPolicy": "least-inflight"}
+    ms = render_values(values)
+    args = ms["router-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args[args.index("--routing-policy") + 1] == "least-inflight"
+    assert "qwen3-engine-deployment.yaml" in ms    # no StatefulSet switch
+    values = copy.deepcopy(VALUES)
+    values["routerSpec"] = {"routingPolicy": "sticky-random"}
+    with pytest.raises(ValueError, match="routingPolicy"):
+        render_values(values)
+
+
+def test_routing_policy_honored_and_validated_on_any_model_spec():
+    """There is ONE router: vllmConfig.routingPolicy works from any
+    modelSpec entry (not just the first), a typo on any entry fails the
+    render, and two entries naming different policies is a contradiction."""
+    def two_models(cfg_a, cfg_b):
+        return {"servingEngineSpec": {"modelSpec": [
+            {"name": "a", "modelURL": "/models/a", "requestGPU": 1,
+             "vllmConfig": cfg_a},
+            {"name": "b", "modelURL": "/models/b", "requestGPU": 1,
+             "vllmConfig": cfg_b}]}}
+
+    ms = render_values(two_models({}, {"routingPolicy": "prefix-affinity"}))
+    args = ms["router-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args[args.index("--routing-policy") + 1] == "prefix-affinity"
+    assert "a-engine-statefulset.yaml" in ms      # both models switch
+    with pytest.raises(ValueError, match="not a known policy"):
+        render_values(two_models({}, {"routingPolicy": "prefix-afinity"}))
+    with pytest.raises(ValueError, match="conflicting"):
+        render_values(two_models({"routingPolicy": "least-inflight"},
+                                 {"routingPolicy": "prefix-affinity"}))
+    # ...and the same contradiction across LAYERS fails too (routerSpec
+    # silently winning would deploy a router the modelSpec believes is
+    # cache-affine).
+    vals = two_models({}, {"routingPolicy": "prefix-affinity"})
+    vals["routerSpec"] = {"routingPolicy": "least-inflight"}
+    with pytest.raises(ValueError, match="contradicts"):
+        render_values(vals)
+    # agreement across layers is not a contradiction
+    vals = two_models({}, {"routingPolicy": "prefix-affinity"})
+    vals["routerSpec"] = {"routingPolicy": "prefix-affinity"}
+    assert render_values(vals)
+
+
+def test_prefix_affinity_renders_per_replica_addressing():
+    """Prefix-affinity needs the ring to own PODS, not a Service VIP
+    (kube-proxy's random pod choice behind one URL would re-scatter
+    sessions): replicaCount renders end-to-end as a StatefulSet with a
+    headless Service and one stable per-pod URL per replica in the
+    router's --replicas."""
+    values = copy.deepcopy(VALUES)
+    values["routerSpec"] = {"routingPolicy": "prefix-affinity"}
+    ms = render_values(values)
+    _validate(ms)
+    assert "qwen3-engine-deployment.yaml" not in ms
+    sts = ms["qwen3-engine-statefulset.yaml"]
+    assert sts["spec"]["replicas"] == 2            # replicaCount
+    assert sts["spec"]["serviceName"] == "kgct-qwen3-engine-hl"
+    assert sts["spec"]["podManagementPolicy"] == "Parallel"
+    hl = ms["qwen3-engine-headless-svc.yaml"]
+    assert hl["spec"]["clusterIP"] == "None"
+    assert hl["spec"]["publishNotReadyAddresses"] is True
+    args = ms["router-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    replicas = args[args.index("--replicas") + 1]
+    assert replicas == (
+        "http://kgct-qwen3-engine-0.kgct-qwen3-engine-hl:8000,"
+        "http://kgct-qwen3-engine-1.kgct-qwen3-engine-hl:8000")
+    # The ordinary per-model Service still renders for non-router clients.
+    assert "qwen3-engine-svc.yaml" in ms
+    # Multihost (pp > 1) keeps its rank-0 Service as ONE routing target
+    # even under affinity: peer ranks must never receive client traffic.
+    values = copy.deepcopy(VALUES)
+    values["routerSpec"] = {"routingPolicy": "prefix-affinity"}
+    values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"][
+        "pipelineParallelSize"] = 2
+    ms = render_values(values)
+    args = ms["router-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args[args.index("--replicas") + 1] == \
+        "http://kgct-qwen3-engine-svc:8000"
 
 
 def test_scrape_annotations_engine_only():

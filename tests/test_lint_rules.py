@@ -465,6 +465,82 @@ class TestSwapOrder:  # KGCT010
         """, "KGCT010", relpath="serving/fake.py") == []
 
 
+class TestRouterPickPath:  # KGCT011
+    def test_min_over_replicas_outside_pick_fires(self):
+        found = lint("""
+            class Router:
+                def proxy(self, request):
+                    replica = min(self.replicas, key=lambda r: r.inflight)
+                    return replica
+        """, "KGCT011", relpath="serving/fake.py")
+        assert len(found) == 1 and "_pick seam" in found[0].message
+
+    def test_sorted_inflight_selection_fires(self):
+        found = lint("""
+            def rebalance(self, healthy):
+                return sorted(healthy, key=lambda r: r.inflight)[0]
+        """, "KGCT011", relpath="serving/fake.py")
+        assert len(found) == 1
+
+    def test_random_choice_from_replicas_fires(self):
+        found = lint("""
+            import random
+
+            def desperate(self):
+                return random.choice(self.replicas)
+        """, "KGCT011", relpath="serving/fake.py")
+        assert len(found) == 1
+
+    def test_inflight_mutation_outside_proxy_fires(self):
+        found = lint("""
+            def metrics(self, replica):
+                replica.inflight = 0
+                return replica
+        """, "KGCT011", relpath="serving/fake.py")
+        assert len(found) == 1 and "accounting pair" in found[0].message
+
+    def test_pick_and_proxy_accounting_are_sanctioned(self):
+        assert lint("""
+            class Router:
+                def _pick(self, exclude=None):
+                    healthy = [r for r in self.replicas if r.healthy]
+                    least = min(r.inflight for r in healthy)
+                    tied = [r for r in healthy if r.inflight == least]
+                    return tied[0]
+
+                def proxy(self, request):
+                    replica = self._pick()
+                    replica.inflight += 1
+                    try:
+                        return self.forward(replica, request)
+                    finally:
+                        replica.inflight -= 1
+        """, "KGCT011", relpath="serving/fake.py") == []
+
+    def test_reads_and_init_stay_silent(self):
+        # health/metrics ITERATE and read the load signal — not selection.
+        assert lint("""
+            class Replica:
+                def __init__(self, url):
+                    self.inflight = 0
+
+            class Router:
+                def health(self, request):
+                    return {r.url: r.inflight for r in self.replicas}
+
+                def metrics(self, request):
+                    total = sum(r.inflight for r in self.replicas)
+                    return total
+        """, "KGCT011", relpath="serving/fake.py") == []
+
+    def test_outside_serving_out_of_scope(self):
+        assert lint("""
+            def schedule(self):
+                victim = min(self.replicas, key=lambda r: r.inflight)
+                victim.inflight += 1
+        """, "KGCT011", relpath="engine/fake.py") == []
+
+
 class TestFramework:
     def test_every_rule_has_code_name_description(self):
         codes = [r.code for r in ALL_RULES]

@@ -1,0 +1,480 @@
+"""Cache-aware fleet routing: hash-ring properties, bounded-load pick
+policy, session stickiness, and membership-churn remap — all pure-Python /
+aiohttp simulation, no engines (the multi-engine router bench phase is the
+one @slow test at the bottom).
+
+Correctness here is a DISTRIBUTION property: the ring must be
+deterministic across router instances (hashlib, never the salted builtin
+``hash``), stable under membership churn (only the dead replica's ~K/N
+keys remap), and the least-inflight default must stay byte-identical to
+the pre-affinity router so existing deployments see zero behavior change.
+"""
+
+import asyncio
+from collections import Counter
+
+import pytest
+
+from kubernetes_gpu_cluster_tpu.resilience.faults import configure_faults
+from kubernetes_gpu_cluster_tpu.serving.router import HashRing, Router
+from test_serving import _assert_valid_exposition
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    configure_faults(None)
+    yield
+    configure_faults(None)
+
+
+URLS = [f"http://replica-{i}:8000" for i in range(4)]
+KEYS = [f"session-{i}".encode() for i in range(400)]
+
+
+class TestHashRing:
+    def test_deterministic_across_instances(self):
+        """Two rings from identical configs agree on every key — the
+        process-restart / multi-router-replica contract (builtin ``hash``
+        is salted per process and would break this silently)."""
+        a, b = HashRing(URLS), HashRing(URLS)
+        assert [a.owner(k) for k in KEYS] == [b.owner(k) for k in KEYS]
+
+    def test_vnode_balance_within_bound(self):
+        """Raw key-space shares stay within ~1.6x fair at RING_VNODES=64
+        (the CHWBL load bound does the rest at pick time)."""
+        counts = Counter(HashRing(URLS).owner(k) for k in KEYS)
+        assert set(counts) == set(URLS), "some replica owns no keys"
+        fair = len(KEYS) / len(URLS)
+        assert max(counts.values()) <= 1.6 * fair, counts
+
+    def test_single_removal_remaps_at_most_2k_over_n(self):
+        """Consistent-hashing contract: removing 1 of N replicas moves
+        ONLY that replica's keys (<= ~K/N, pinned at 2K/N); every other
+        key keeps its owner."""
+        full = HashRing(URLS)
+        shrunk = HashRing(URLS[:-1])
+        moved = sum(1 for k in KEYS if full.owner(k) != shrunk.owner(k))
+        assert moved <= 2 * len(KEYS) / len(URLS), moved
+        survivors_moved = [
+            k for k in KEYS
+            if full.owner(k) != URLS[-1] and full.owner(k) != shrunk.owner(k)]
+        assert survivors_moved == []
+
+    def test_walk_skip_equals_membership_removal(self):
+        """Skipping a dead URL while walking the full ring lands exactly
+        where a ring built without it would — health churn never needs a
+        ring rebuild."""
+        full = HashRing(URLS)
+        shrunk = HashRing(URLS[:-1])
+        for k in KEYS[:100]:
+            walked = next(u for u in full.walk(k) if u != URLS[-1])
+            assert walked == shrunk.owner(k)
+
+    def test_walk_yields_every_member_once(self):
+        walk = list(HashRing(URLS).walk(b"any-key"))
+        assert sorted(walk) == sorted(URLS)
+        assert walk[0] == HashRing(URLS).owner(b"any-key")
+
+
+def _router(policy="prefix-affinity", urls=URLS, **kw):
+    # Never started: _pick / _affinity_key are pure and need no session.
+    return Router(list(urls), routing_policy=policy, **kw)
+
+
+class TestPickPolicy:
+    def test_identical_configs_identical_assignments(self):
+        """Acceptance pin: two router instances with the same config route
+        K sampled keys identically."""
+        r1, r2 = _router(), _router()
+        assert ([r1._pick(affinity_key=k).url for k in KEYS]
+                == [r2._pick(affinity_key=k).url for k in KEYS])
+
+    def test_session_stickiness(self):
+        router = _router()
+        first = router._pick(affinity_key=b"sticky")
+        for _ in range(5):
+            assert router._pick(affinity_key=b"sticky") is first
+        assert router.affinity_hits_total == 6
+        assert router.affinity_requests_total == 6
+
+    def test_bounded_load_overflow_walks_to_ring_successor(self):
+        """An over-bound owner spills to the NEXT under-bound replica in
+        ring order (deterministic — not least-inflight scatter), and the
+        overflow is charged to the owner's counter."""
+        router = _router(balance_factor=1.0)
+        key = b"hot-prefix"
+        owner_url = router.ring.owner(key)
+        owner = next(r for r in router.replicas if r.url == owner_url)
+        owner.inflight = 8      # others idle: bound = ceil(9/4) = 3
+        picked = router._pick(affinity_key=key)
+        successor = next(u for u in router.ring.walk(key)
+                         if u != owner_url)
+        assert picked.url == successor
+        assert router.affinity_overflow_total[owner_url] == 1
+        assert router.affinity_hits_total == 0
+        # Owner drains below bound: the key comes home.
+        owner.inflight = 0
+        assert router._pick(affinity_key=key).url == owner_url
+
+    def test_unhealthy_owner_remaps_and_recovers(self):
+        router = _router()
+        key = b"some-session"
+        owner_url = router.ring.owner(key)
+        owner = next(r for r in router.replicas if r.url == owner_url)
+        owner.healthy = False
+        picked = router._pick(affinity_key=key)
+        assert picked.url == next(u for u in router.ring.walk(key)
+                                  if u != owner_url)
+        assert router.ring_remaps_total == 1
+        owner.healthy = True
+        assert router._pick(affinity_key=key).url == owner_url
+
+    def test_retry_exclude_flows_through_pick_seam(self):
+        """The connect-failure retry path (exclude=tried) remaps the SAME
+        affinity key deterministically to the ring successor — same seam,
+        same walk."""
+        router = _router()
+        key = b"retry-me"
+        first = router._pick(affinity_key=key)
+        second = router._pick(affinity_key=key, exclude={first.url})
+        assert second is not None and second.url != first.url
+        assert second.url == next(u for u in router.ring.walk(key)
+                                  if u != first.url)
+        third = router._pick(affinity_key=key,
+                             exclude={first.url, second.url})
+        assert third.url == next(u for u in router.ring.walk(key)
+                                 if u not in (first.url, second.url))
+
+    def test_walk_always_places_when_candidates_exist(self):
+        """CHWBL never refuses: for any load vector some candidate sits
+        under ceil(c*(L+1)/n) (pigeonhole), so an affinity pick with live
+        replicas always returns one."""
+        import random
+        rng = random.Random(7)
+        router = _router(balance_factor=1.0)
+        for _ in range(200):
+            for r in router.replicas:
+                r.inflight = rng.randrange(0, 30)
+            assert router._pick(affinity_key=b"k") is not None
+
+    def test_no_key_falls_back_to_least_inflight(self):
+        router = _router()
+        router.replicas[2].inflight = 0
+        for r in router.replicas[:2]:
+            r.inflight = 5
+        router.replicas[3].inflight = 5
+        assert router._pick(affinity_key=None) is router.replicas[2]
+        assert router.affinity_requests_total == 0
+
+    def test_least_inflight_byte_identical_to_pre_affinity_router(self):
+        """Acceptance pin: the default policy reproduces the pre-PR
+        algorithm choice-for-choice — min inflight, ties broken by a
+        0-based round-robin counter over the tied list in replica order —
+        across a scripted sequence of loads, exclusions, and health flips.
+        """
+        import itertools
+
+        router = _router(policy="least-inflight")
+        legacy_rr = itertools.count()
+
+        def legacy_pick(replicas, exclude=None, include_unhealthy=False):
+            healthy = [r for r in replicas
+                       if (r.healthy or include_unhealthy)
+                       and (not exclude or r.url not in exclude)]
+            if not healthy:
+                return None
+            least = min(r.inflight for r in healthy)
+            tied = [r for r in healthy if r.inflight == least]
+            return tied[next(legacy_rr) % len(tied)]
+
+        script = [
+            dict(loads=[0, 0, 0, 0]),
+            dict(loads=[0, 0, 0, 0]),
+            dict(loads=[2, 0, 1, 0]),
+            dict(loads=[2, 0, 1, 0], exclude={URLS[1]}),
+            dict(loads=[1, 1, 1, 1], unhealthy={URLS[0]}),
+            dict(loads=[3, 3, 3, 3], unhealthy={URLS[0]},
+                 include_unhealthy=True),
+            dict(loads=[0, 5, 0, 5]),
+            dict(loads=[0, 5, 0, 5]),
+            dict(loads=[0, 5, 0, 5], exclude={URLS[0], URLS[2]}),
+        ]
+        for step in script:
+            for r, load in zip(router.replicas, step["loads"]):
+                r.inflight = load
+                r.healthy = r.url not in step.get("unhealthy", ())
+            expect = legacy_pick(router.replicas,
+                                 exclude=step.get("exclude"),
+                                 include_unhealthy=step.get(
+                                     "include_unhealthy", False))
+            got = router._pick(exclude=step.get("exclude"),
+                               include_unhealthy=step.get(
+                                   "include_unhealthy", False))
+            assert got is expect, step
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError, match="routing_policy"):
+            Router(URLS, routing_policy="round-robin")
+        with pytest.raises(ValueError, match="balance_factor"):
+            Router(URLS, routing_policy="prefix-affinity",
+                   balance_factor=0.5)
+
+
+class TestAffinityKey:
+    def test_session_id_beats_user_beats_prompt(self):
+        router = _router()
+        body = (b'{"prompt": "abc", "user": "u1", "session_id": "s1"}')
+        assert router._affinity_key(body) == \
+            b"sticky:session_id:s1"
+        body = b'{"prompt": "abc", "user": "u1"}'
+        assert router._affinity_key(body) == \
+            b"sticky:user:u1"
+
+    def test_prompt_prefix_windows(self):
+        router = _router(affinity_prefix_len=4)
+        # token-array prompt: first N ids
+        assert router._affinity_key(
+            b'{"prompt": [5, 6, 7, 8, 9, 10]}') == b"tokens:5,6,7,8"
+        # text prompt: first 4*N utf-8 bytes
+        key = router._affinity_key(b'{"prompt": "abcdefghijklmnopqrstuvwx"}')
+        assert key == b"text:abcdefghijklmnop"
+        # chat: serialized messages prefix (shared system prompts collide
+        # into the same key, unrelated sessions with different prompts
+        # diverge once past the boilerplate)
+        k1 = router._affinity_key(
+            b'{"messages": [{"role": "user", "content": "hi"}]}')
+        assert k1 is not None and k1.startswith(b"chat:")
+
+    def test_unparseable_or_keyless_bodies_yield_none(self):
+        router = _router()
+        assert router._affinity_key(b"") is None
+        assert router._affinity_key(b"not json") is None
+        assert router._affinity_key(b'[1, 2]') is None
+        assert router._affinity_key(b'{"n": 1}') is None
+        # bool session_id is not a usable scalar key
+        assert router._affinity_key(b'{"session_id": true, "n": 1}') is None
+
+    def test_least_inflight_policy_never_peeks(self):
+        router = _router(policy="least-inflight")
+        assert router._affinity_key(b'{"session_id": "s"}') is None
+
+
+# ---------------------------------------------------------------------------
+# aiohttp-level: streaming stickiness, churn remap, metrics aggregation
+# ---------------------------------------------------------------------------
+
+async def _recording_replica(extra_metrics=""):
+    """A stand-in engine replica that records served completion requests
+    and streams an SSE body (so stickiness is proven on the STREAMING
+    proxy path — the body-peek must not break passthrough)."""
+    from aiohttp import web as aioweb
+
+    served = []
+
+    async def health(request):
+        return aioweb.json_response({"status": "ok"})
+
+    async def metrics(request):
+        return aioweb.Response(
+            text="# TYPE kgct_requests_total counter\n"
+                 f"kgct_requests_total {len(served)}\n" + extra_metrics,
+            content_type="text/plain")
+
+    async def completions(request):
+        served.append(await request.json())
+        resp = aioweb.StreamResponse(
+            headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        await resp.write(b'data: {"text": "tok"}\n\n')
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    app = aioweb.Application()
+    app.router.add_get("/health", health)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_post("/v1/completions", completions)
+    runner = aioweb.AppRunner(app)
+    await runner.setup()
+    site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, f"http://127.0.0.1:{runner.addresses[0][1]}", served
+
+
+async def _start_router(router):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(router.build_app()))
+    await client.start_server()
+    return client
+
+
+class TestStreamedStickiness:
+    def test_session_requests_stream_through_one_replica(self):
+        async def scenario():
+            a_runner, a_url, a_served = await _recording_replica()
+            b_runner, b_url, b_served = await _recording_replica()
+            router = Router([a_url, b_url], health_interval_s=9999,
+                            routing_policy="prefix-affinity")
+            client = await _start_router(router)
+            try:
+                for i in range(4):
+                    r = await client.post(
+                        "/v1/completions",
+                        json={"prompt": f"turn {i} of this conversation",
+                              "session_id": "conv-42", "stream": True})
+                    assert r.status == 200
+                    body = await r.read()
+                    assert b"[DONE]" in body      # stream passed through
+                # All four landed on ONE replica (whichever owns the key).
+                assert sorted([len(a_served), len(b_served)]) == [0, 4]
+                assert router.affinity_hits_total == 4
+                # A different session may land elsewhere, but is also
+                # sticky to wherever it lands.
+                for i in range(2):
+                    await client.post(
+                        "/v1/completions",
+                        json={"prompt": "x", "session_id": "conv-43"})
+                assert (len(a_served), len(b_served)) in (
+                    (6, 0), (0, 6), (4, 2), (2, 4))
+            finally:
+                await client.close()
+                await a_runner.cleanup()
+                await b_runner.cleanup()
+        asyncio.run(scenario())
+
+
+@pytest.mark.chaos
+class TestReplicaDownRemap:
+    def test_downed_replica_keys_remap_then_return(self):
+        """KGCT_FAULT replica_down: the health probe of the ring owner is
+        forced down; its keys deterministically remap to the ring
+        successor; clearing the fault restores the owner and the keys come
+        home. The drain/429 machinery is untouched (other keys never
+        move)."""
+        async def scenario():
+            a_runner, a_url, _ = await _recording_replica()
+            b_runner, b_url, _ = await _recording_replica()
+            router = Router([a_url, b_url], health_interval_s=9999,
+                            routing_policy="prefix-affinity")
+            client = await _start_router(router)
+            try:
+                key = b"sticky:session_id:chaos"
+                owner_url = router.ring.owner(key)
+                own_idx = [r.url for r in router.replicas].index(owner_url)
+                other_url = [u for u in (a_url, b_url) if u != owner_url][0]
+                other_key = next(
+                    k for k in (f"probe-{i}".encode() for i in range(64))
+                    if router.ring.owner(k) == other_url)
+                assert router._pick(affinity_key=key).url == owner_url
+
+                configure_faults(f"replica_down:value={own_idx}")
+                for r in router.replicas:
+                    await router._check(r, startup=True)
+                assert not router.replicas[own_idx].healthy
+                # Owned keys remap to the survivor...
+                assert router._pick(affinity_key=key).url == other_url
+                assert router.ring_remaps_total == 1
+                # ...other keys never move (only K/N remap on churn).
+                assert router._pick(affinity_key=other_key).url == other_url
+
+                configure_faults(None)
+                for r in router.replicas:
+                    await router._check(r)
+                assert router.replicas[own_idx].healthy
+                assert router._pick(affinity_key=key).url == owner_url
+
+                # The fire budget is consumed ONLY by the targeted
+                # replica's probes: with times=1, probing every OTHER
+                # replica first must not burn the single fire.
+                router.replicas[own_idx].benched_until = 0.0
+                configure_faults(f"replica_down:value={own_idx},times=1")
+                for r in router.replicas:
+                    if r is not router.replicas[own_idx]:
+                        await router._check(r)
+                assert all(r.healthy for r in router.replicas)
+                await router._check(router.replicas[own_idx], startup=True)
+                assert not router.replicas[own_idx].healthy
+            finally:
+                await client.close()
+                await a_runner.cleanup()
+                await b_runner.cleanup()
+        asyncio.run(scenario())
+
+
+class TestRouterMetricsAggregation:
+    def test_replica_locality_gauges_zero_and_absent_safe(self):
+        """The router folds each replica's scraped prefix-cache hit ratio
+        and swapped count into router-owned labeled gauges. A replica
+        whose engine predates the series (or was skipped) still gets a 0.0
+        sample — a fresh scrape is nan-free and needs no existence check —
+        and the affinity counters render zeros on a fresh least-inflight
+        router."""
+        async def scenario():
+            a_runner, a_url, _ = await _recording_replica(
+                extra_metrics=(
+                    "# TYPE kgct_prefix_cache_hit_ratio gauge\n"
+                    "kgct_prefix_cache_hit_ratio 0.75\n"
+                    "# TYPE kgct_num_swapped gauge\n"
+                    "kgct_num_swapped 2\n"))
+            b_runner, b_url, _ = await _recording_replica()  # no series
+            router = Router([a_url, b_url], health_interval_s=9999)
+            client = await _start_router(router)
+            try:
+                r = await client.get("/metrics")
+                text = await r.text()
+                _assert_valid_exposition(text)
+
+                def val(name, url):
+                    [line] = [l for l in text.splitlines()
+                              if l.startswith(f'{name}{{replica="{url}"}}')]
+                    return float(line.rpartition(" ")[2])
+
+                assert val("kgct_router_replica_prefix_cache_hit_ratio",
+                           a_url) == 0.75
+                assert val("kgct_router_replica_prefix_cache_hit_ratio",
+                           b_url) == 0.0
+                assert val("kgct_router_replica_num_swapped", a_url) == 2.0
+                assert val("kgct_router_replica_num_swapped", b_url) == 0.0
+                # Affinity accounting: present and zero-safe even on the
+                # default policy with zero affinity-keyed traffic.
+                assert "kgct_router_affinity_hit_ratio 0.0" in text
+                assert "kgct_router_ring_remaps_total 0" in text
+                assert val("kgct_router_affinity_overflow_total",
+                           a_url) == 0.0
+                assert ('kgct_router_policy{policy="least-inflight"} 1'
+                        in text)
+            finally:
+                await client.close()
+                await a_runner.cleanup()
+                await b_runner.cleanup()
+        asyncio.run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# The multi-engine bench phase (real engines behind the real router)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestRouterBenchPhase:
+    def test_affinity_concentrates_locality_over_least_inflight(self):
+        """The KGCT_BENCH_ROUTER A/B end-to-end: the affinity arm routes
+        every session to its ring owner (hit ratio 1.0, zero remaps), the
+        owner replica's prefix-cache hit ratio strictly exceeds the
+        least-inflight arm's best, and the headline ratio is present. The
+        routing-count assertions are deterministic; wall-clock only gets a
+        loose sanity bound (this is the bench's job to measure)."""
+        import bench
+
+        out = bench._measure_router()
+        li, aff = out["least_inflight"], out["prefix_affinity"]
+        assert aff["affinity_hit_ratio"] == 1.0
+        assert aff["ring_remaps"] == 0
+        li_best = max((p["hit_ratio"] or 0.0) for p in li["per_replica"])
+        owner_ratios = [p["hit_ratio"] for p in aff["per_replica"]
+                        if p["requests"] > 0]
+        assert owner_ratios and min(owner_ratios) > li_best
+        # Sessions scattered under least-inflight (both replicas served)...
+        assert all(p["requests"] > 0 for p in li["per_replica"])
+        assert out["warm_ttft_ratio"] is not None
+        assert out["warm_ttft_ratio"] < 1.5   # loose: not a perf pin

@@ -739,6 +739,30 @@ class TestClientDisconnectAborts:
         loop.run_until_complete(go())
 
 
+class TestSessionAffinityPassthrough:
+    def test_session_id_and_user_accepted_and_validated(self, api_client):
+        """The prefix-affinity router's stickiness keys pass through the
+        engine: scalar session_id/user are accepted (and otherwise
+        ignored); non-scalar values are a loud 400 — they would silently
+        change the ROUTER's per-request hashing semantics."""
+        loop, client = api_client
+
+        async def go():
+            r = await client.post("/v1/completions", json={
+                "prompt": "hi", "max_tokens": 2, "temperature": 0.0,
+                "session_id": "conv-1", "user": "u-9"})
+            assert r.status == 200
+            r2 = await client.post("/v1/completions", json={
+                "prompt": "hi", "max_tokens": 2,
+                "session_id": {"nested": "object"}})
+            assert r2.status == 400
+            assert "session_id" in (await r2.json())["error"]["message"]
+            r3 = await client.post("/v1/completions", json={
+                "prompt": "hi", "max_tokens": 2, "user": ["a", "b"]})
+            assert r3.status == 400
+        loop.run_until_complete(go())
+
+
 class TestMultipleCompletions:
     def test_n_choices(self, api_client):
         """OpenAI n > 1: n concurrent engine requests gathered into indexed
