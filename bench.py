@@ -492,6 +492,11 @@ def _measure_overload(engine, rng, vocab, rate_rps, budget_ms):
     n = OVERLOAD_REQUESTS
     max_retries = 2
     adm = AdmissionController(engine, default_budget_ms=budget_ms)
+    # SLO layer reads for THIS phase: grade attainment against the same
+    # budget admission control sheds on, over a window that starts here
+    # (the sustained phase's samples would dilute the overload readout).
+    engine.obs.slo.ttft_budget_ms = budget_ms
+    engine.obs.slo.clear()
     params = SamplingParams(temperature=0.0, max_tokens=LOAD_MAX_NEW)
     arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, n))
     attempt_at = list(arrivals)          # next admission attempt per request
@@ -554,6 +559,13 @@ def _measure_overload(engine, rng, vocab, rate_rps, budget_ms):
         "ttft_p95_ms": (round(_percentile(ttfts, 0.95) * 1e3, 1)
                         if ttfts else None),
         "ttft_budget_violations": violations,
+        # The rolling SLO gauges the autoscaler (ROADMAP 4(b)) will consume,
+        # read engine-side at phase end: attainment over the phase's
+        # admitted requests and budget-meeting goodput. BENCH_r06 captures
+        # attainment alongside raw TTFT.
+        "slo_ttft_attainment_ratio": round(engine.obs.slo.attainment(), 3),
+        "slo_goodput_tokens_per_sec": round(
+            engine.obs.slo.goodput_tokens_per_sec(), 1),
     }
 
 
@@ -967,7 +979,31 @@ def _measure_router() -> dict:
                         "cache_misses": int(misses),
                         "hit_ratio": (round(hits / (hits + misses), 3)
                                       if hits + misses else None),
+                        # The per-replica SLO gauge the fleet autoscaler
+                        # reads (one scrape per replica, same surface).
+                        "slo_ttft_attainment_ratio": scrape(
+                            text, "kgct_slo_ttft_attainment_ratio"),
                     })
+                # Fleet-merged trace: ONE download of the router's
+                # /debug/trace must hold the router's own spans AND engine
+                # lifecycle spans from the replicas, correlated on the
+                # router-minted request ids (the acceptance contract; the
+                # summary rides the stderr FULL_RESULT, not the headline).
+                async with sess.get(f"{router_url}/debug/trace") as resp:
+                    tdoc = await resp.json()
+                ids_by_pid: dict = {}
+                for e in tdoc["traceEvents"]:
+                    if e.get("cat") == "request" and e.get("id"):
+                        ids_by_pid.setdefault(e["pid"], set()).add(e["id"])
+                router_ids = ids_by_pid.get(1, set())
+                out["merged_trace"] = {
+                    "processes": len({e.get("pid")
+                                      for e in tdoc["traceEvents"]}),
+                    "router_requests": len(router_ids),
+                    "replicas_sharing_ids": sum(
+                        1 for pid, ids in ids_by_pid.items()
+                        if pid != 1 and ids & router_ids),
+                }
                 out.update({
                     "ttft_cold_p50_ms": round(_median(cold) * 1e3, 1),
                     "ttft_warm_p50_ms": round(_median(warm) * 1e3, 1),
@@ -1226,6 +1262,12 @@ def assemble_output(results: list[dict], backend: str) -> dict:
         # A/B block in configs[-1].router_affinity).
         "router_affinity_warm_over_li_ttft": (
             primary.get("router_affinity", {}).get("warm_ttft_ratio")),
+        # SLO headline: fraction of the overload phase's admitted requests
+        # whose TTFT met the admission budget — the attainment read
+        # BENCH_r06 captures alongside raw TTFT (full block in
+        # configs[-1].overload).
+        "slo_ttft_attainment_ratio": (
+            primary.get("overload", {}).get("slo_ttft_attainment_ratio")),
         "configs": results,
     }
 
@@ -1301,6 +1343,7 @@ _DROPPABLE_HEADLINE = ("ttft_decomposition", "baseline_bar", "mixed_batch",
                        "prefix_warm_over_cold_ttft",
                        "swap_resume_over_recompute_ttft", "preemptions",
                        "router_affinity_warm_over_li_ttft",
+                       "slo_ttft_attainment_ratio",
                        "decode_window", "prefill_budget", "vs_baseline")
 
 

@@ -16,6 +16,7 @@ from .logging_hygiene import LoggingHygieneRule
 from .quant_surface import QuantSurfaceRule
 from .router_pick import RouterPickPathRule
 from .swap_order import SwapOrderRule
+from .trace_emit import TraceEmitHygieneRule
 
 ALL_RULES = [
     TraceSafetyRule(),
@@ -29,6 +30,7 @@ ALL_RULES = [
     QuantSurfaceRule(),
     SwapOrderRule(),
     RouterPickPathRule(),
+    TraceEmitHygieneRule(),
 ]
 
 

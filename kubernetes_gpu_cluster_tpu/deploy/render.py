@@ -378,15 +378,19 @@ def _render_router(replica_urls: list[str], router_spec: dict,
                 "replicas": router_spec.get("replicaCount", 1),
                 "selector": {"matchLabels": labels},
                 "template": {
-                    # NO scrape annotations here: the router's /metrics
-                    # re-exports every healthy engine's series (replica-
-                    # labeled), so scraping it alongside the annotated
-                    # engine pods would double-ingest each sample and
-                    # double every sum()/rate() across the stack. The
-                    # router is the scrape target for setups that cannot
-                    # reach pod IPs; annotation-based discovery uses the
-                    # engine pods directly.
-                    "metadata": {"labels": labels},
+                    # The router pod IS a scrape target now: its /metrics
+                    # is the fleet aggregation point — router-owned series
+                    # (affinity hit ratio, per-replica locality gauges,
+                    # retries/scrape-error counters) exist nowhere else.
+                    # Caveat for dashboards: the router also re-exports
+                    # every engine's series relabeled with replica="...",
+                    # so fleet-wide sum()/rate() over ENGINE families must
+                    # group by scrape job (or filter on the replica label)
+                    # to avoid counting each sample twice — documented in
+                    # README "Observability".
+                    "metadata": {"labels": labels,
+                                 "annotations": _scrape_annotations(
+                                     ROUTER_PORT)},
                     "spec": {"containers": [{
                         "name": "router",
                         "image": router_spec.get("image", DEFAULT_IMAGE),

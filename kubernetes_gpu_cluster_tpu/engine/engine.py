@@ -297,6 +297,36 @@ class LLMEngine:
                 # The KV-slot shadow learns that a swapped-in slot is
                 # committed history (stale spec slots died with the swap).
                 self.swapper.on_restored = self._sanitizer.on_swap_restore
+        # Black-box flight recorder: periodic state snapshots (queue depths,
+        # KV occupancy both tiers) ride Observability.on_step; the source is
+        # O(1) attribute reads, never a device sync (KGCT012).
+        self.obs.flight.set_snapshot_source(self._flight_snapshot)
+
+    def _flight_snapshot(self) -> dict:
+        sched = self.scheduler
+        alloc = sched.allocator
+        snap = {"waiting": len(sched.waiting), "running": len(sched.running),
+                "swapped": len(sched.swapped), "step": self.step_count,
+                "kv_pages_free": alloc.num_free,
+                "kv_pages_total": alloc.num_pages}
+        if self.swapper is not None:
+            snap["host_pages_in_use"] = self.swapper.host.num_in_use
+            snap["host_pages_total"] = self.swapper.host.num_pages
+        return snap
+
+    def compiled_step_variants(self) -> int:
+        """Total jit-cache entries across every step program — the number of
+        distinct XLA compilations serving has paid so far. The same count
+        the tier-1 compile guard bounds (tests/test_compile_guard.py), now
+        exported as ``kgct_jit_compiles_total``: a steady-state serving
+        process holds this flat, so any growth under constant traffic is a
+        recompilation storm in progress."""
+        fns = [self._prefill_fn, self._prefill_hist_fn, self._mixed_fn,
+               self._decode_fn, self._decode_fn_greedy, self._spec_verify_fn]
+        if self.swapper is not None:
+            fns += [self.swapper._gather_fn, self.swapper._scatter_fn]
+        return sum(fn._cache_size() for fn in fns
+                   if fn is not None and hasattr(fn, "_cache_size"))
 
     def _set_kv_cache(self, kv: KVCache) -> None:
         """Swap-in rebinding seam: the scatter donates the pool, so the
@@ -1518,3 +1548,19 @@ def _device_free_memory() -> Optional[int]:
     except Exception:
         pass
     return None
+
+
+def device_memory_stats() -> tuple:
+    """(bytes_limit, bytes_in_use) of the first addressable device — the
+    ``kgct_hbm_bytes_{limit,in_use}`` gauges. (0, 0) when the backend
+    reports nothing (CPU) so a fresh scrape is nan-free by construction;
+    reading the runtime's counters is a host-side C call, never a device
+    sync."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            return (int(stats.get("bytes_limit", 0) or 0),
+                    int(stats.get("bytes_in_use", 0) or 0))
+    except Exception:
+        pass
+    return (0, 0)

@@ -8,23 +8,116 @@ Everything here is bounded (rings + fixed-bucket histograms) and lock-free
 on the hot path — the engine step loop must never block on observability.
 
 Disable entirely with ``KGCT_TRACE=0`` (hooks become cheap early-returns;
-histograms still fill — they are the /metrics contract).
+histograms still fill — they are the /metrics contract). The black-box
+flight recorder (flightrecorder.py) mirrors the same events into its own
+always-on ring (kill switch ``KGCT_FLIGHT=0``) and is NOT touched by
+``/debug/trace?clear=1`` — a scoped capture must never erase the crash
+evidence.
 """
 
 from __future__ import annotations
 
-import os
 import time
 from collections import deque
 
+from .flightrecorder import FlightRecorder
 from .phases import PHASES, StepPhaseStats
 from .prometheus import (BATCH_BUCKETS, LATENCY_BUCKETS_S, Histogram, fmt,
                          render_gauge)
-from .trace import EVENT_KINDS, RequestTracer
+from .trace import EVENT_KINDS, RequestTracer, merge_perfetto
 
 __all__ = ["Observability", "Histogram", "RequestTracer", "StepPhaseStats",
+           "FlightRecorder", "SLOTracker", "merge_perfetto",
            "EVENT_KINDS", "PHASES", "LATENCY_BUCKETS_S", "BATCH_BUCKETS",
            "render_gauge", "fmt"]
+
+# The attainment bar when no admission-control budget is configured: the
+# north-star "p50 TTFT <= 1 s" target. An operator budget
+# (ResilienceConfig.default_ttft_budget_ms, wired by the API server)
+# overrides it so the SLO gauge and the 429 shed line agree on one number.
+SLO_DEFAULT_TTFT_BUDGET_MS = 1000.0
+
+
+class SLOTracker:
+    """Rolling SLO view over recent requests — the autoscaler-facing signal
+    (ROADMAP item 4(b)): what fraction of recent traffic met its TTFT
+    budget, and how many tokens/s the budget-meeting requests delivered
+    (goodput — raw tok/s counts tokens nobody would have waited for).
+
+    Bounded by construction: a fixed-size TTFT window (count-based, the
+    last N first tokens) and a time-pruned goodput window. All reads are
+    nan-free: attainment over an empty window is 1.0 (nothing has missed
+    its budget), goodput is 0.0.
+
+    Thread model: the engine WORKER thread writes (on_first_token /
+    on_finish inside the step loop) while the HTTP thread reads
+    (/metrics render). Writers are single-threaded and own all mutation
+    (including the goodput prune); readers take a ``list()`` snapshot of
+    each deque — atomic under the GIL — and never mutate, so a scrape can
+    land mid-append without a 'deque mutated during iteration' error or a
+    popleft race."""
+
+    def __init__(self, ttft_budget_ms=None, window: int = 256,
+                 goodput_window_s: float = 60.0):
+        self.ttft_budget_ms = ttft_budget_ms     # None -> default bar
+        self.goodput_window_s = goodput_window_s
+        self._ttfts: deque = deque(maxlen=window)
+        self._good: deque = deque()              # (finish_ts, tokens)
+        # Start of the observation span (reset by clear()): a server up
+        # 10 s must divide its goodput by 10 s, not the full 60 s window.
+        self._window_start = time.monotonic()
+
+    @property
+    def budget_ms(self) -> float:
+        return (self.ttft_budget_ms if self.ttft_budget_ms is not None
+                else SLO_DEFAULT_TTFT_BUDGET_MS)
+
+    def on_first_token(self, ttft_s: float) -> None:
+        self._ttfts.append(ttft_s)
+
+    def on_finish(self, ttft_s: float, n_tokens: int) -> None:
+        if n_tokens <= 0 or ttft_s * 1e3 > self.budget_ms:
+            return
+        now = time.monotonic()
+        self._good.append((now, n_tokens))
+        # Writer-side prune bounds the deque to ~the window's finishes;
+        # only this (single) writer thread ever pops.
+        cutoff = now - self.goodput_window_s
+        good = self._good
+        while good and good[0][0] < cutoff:
+            good.popleft()
+
+    def attainment(self) -> float:
+        """Fraction of the recent TTFT window under the budget; 1.0 on an
+        empty window (a fresh server has missed nothing)."""
+        ttfts = list(self._ttfts)          # snapshot: reader never iterates live
+        if not ttfts:
+            return 1.0
+        bar = self.budget_ms
+        return sum(1 for t in ttfts if t * 1e3 <= bar) / len(ttfts)
+
+    def goodput_tokens_per_sec(self) -> float:
+        """Tokens/s delivered by budget-meeting requests over the rolling
+        window — 0.0 when idle. The denominator is the OBSERVED span
+        (capped at the window): dividing a 10 s-old server's tokens by the
+        full 60 s would systematically understate goodput. Read-only: the
+        window filter re-applies on the snapshot (entries the writer has
+        not pruned yet but that aged out are excluded here too)."""
+        now = time.monotonic()
+        cutoff = now - self.goodput_window_s
+        tokens = sum(n for ts, n in list(self._good) if ts >= cutoff)
+        if not tokens:
+            return 0.0
+        span = min(self.goodput_window_s,
+                   max(now - self._window_start, 1e-6))
+        return tokens / span
+
+    def clear(self) -> None:
+        """Reset the rolling windows (bench phase boundaries); the budget
+        stays."""
+        self._ttfts.clear()
+        self._good.clear()
+        self._window_start = time.monotonic()
 
 
 def _outcome(seq, reason) -> str:
@@ -42,9 +135,18 @@ def _outcome(seq, reason) -> str:
 class Observability:
     def __init__(self, trace_capacity: int = 8192,
                  enabled: bool = None):
-        if enabled is None:
-            enabled = os.environ.get("KGCT_TRACE", "1") != "0"
-        self.tracer = RequestTracer(capacity=trace_capacity, enabled=enabled)
+        # Black-box flight recorder: mirrors every trace emit into its own
+        # bounded ring (plus periodic state snapshots) and dumps to a JSON
+        # file on fatal transitions — independent kill switch KGCT_FLIGHT=0.
+        self.flight = FlightRecorder()
+        # enabled=None: the tracer resolves the KGCT_TRACE kill switch
+        # itself (the one definition, shared with the router's tracer).
+        self.tracer = RequestTracer(capacity=trace_capacity, enabled=enabled,
+                                    recorder=self.flight)
+        # Rolling SLO layer: TTFT attainment + goodput, the autoscaler
+        # signals. The API server points ttft_budget_ms at the admission
+        # controller's budget so both layers grade against one bar.
+        self.slo = SLOTracker()
         self.phases = StepPhaseStats()
         self.ttft = Histogram(
             "kgct_ttft_seconds", "time to first token", labels=("outcome",))
@@ -131,6 +233,7 @@ class Observability:
     def on_first_token(self, seq, fetch_s: float = 0.0) -> None:
         ttft = seq.first_token_time - seq.arrival_time
         self.ttft.observe(ttft, (_outcome(seq, None),))
+        self.slo.on_first_token(ttft)
         queue = ((seq.scheduled_time - seq.arrival_time)
                  if seq.scheduled_time is not None else 0.0)
         prefill = max(ttft - queue - fetch_s, 0.0)
@@ -152,6 +255,12 @@ class Observability:
         self.e2e_latency.observe(seq.finish_time - seq.arrival_time,
                                  (outcome,))
         n = seq.num_output_tokens
+        # Goodput counts DELIVERED work only: an aborted request's tokens
+        # were generated but nobody received them (client disconnect /
+        # group-abort), and counting them would overstate the autoscaler's
+        # throughput signal under client churn.
+        if seq.first_token_time is not None and outcome != "aborted":
+            self.slo.on_finish(seq.first_token_time - seq.arrival_time, n)
         if seq.first_token_time is not None and n >= 2:
             self.tpot.observe(
                 (seq.finish_time - seq.first_token_time) / (n - 1))
@@ -164,6 +273,9 @@ class Observability:
                 new_tokens: int, mode: str = None, prefill_tokens: int = 0,
                 decode_tokens: int = 0, drafted_tokens: int = 0,
                 accepted_tokens: int = 0) -> None:
+        # Flight-recorder state snapshot, at most once per interval: one
+        # monotonic read per step when nothing is due.
+        self.flight.maybe_snapshot()
         self.step_duration.observe(duration_s)
         self.batch_size.observe(batch)
         self.phases.end_step(step=step, kind=kind, batch=batch,
@@ -248,6 +360,29 @@ class Observability:
             lines.append(
                 "kgct_step_phase_seconds_total{phase=\"%s\"} %s"
                 % (p, fmt(round(self.phases.totals.get(p, 0.0), 6))))
+        # Per-phase mean step time, promoted from the tracer's breakdown so
+        # dashboards read "where a step's wall time goes" without computing
+        # rate ratios; zeros before any step — a fresh scrape is nan-free.
+        lines.append("# TYPE kgct_step_phase_mean_seconds gauge")
+        for p in PHASES:
+            n = self.phases.counts.get(p, 0)
+            mean = self.phases.totals.get(p, 0.0) / n if n else 0.0
+            lines.append(
+                "kgct_step_phase_mean_seconds{phase=\"%s\"} %s"
+                % (p, fmt(round(mean, 9))))
+        # Rolling SLO layer (autoscaler signals, ROADMAP 4(b)): attainment
+        # of the admission-control TTFT budget over recent requests, the
+        # budget itself, and budget-meeting goodput. 1.0 / 0.0 when fresh.
+        lines += [
+            "# TYPE kgct_slo_ttft_budget_ms gauge",
+            f"kgct_slo_ttft_budget_ms {fmt(self.slo.budget_ms)}",
+            "# TYPE kgct_slo_ttft_attainment_ratio gauge",
+            "kgct_slo_ttft_attainment_ratio "
+            f"{fmt(round(self.slo.attainment(), 6))}",
+            "# TYPE kgct_slo_goodput_tokens_per_sec gauge",
+            "kgct_slo_goodput_tokens_per_sec "
+            f"{fmt(round(self.slo.goodput_tokens_per_sec(), 3))}",
+        ]
         lines.extend(render_gauge("kgct_sampled_decode_ratio",
                                   self.sampled_decode_ratio()))
         lines.extend(render_gauge("kgct_mixed_step_ratio",

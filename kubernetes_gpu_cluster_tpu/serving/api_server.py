@@ -12,7 +12,16 @@ The reference's user-facing contract: an OpenAI API served behind
 - ``GET  /metrics``               Prometheus text format (serving.metrics)
 - ``GET  /debug/trace``           request-lifecycle + step-phase trace
                                   (Chrome/Perfetto trace-event JSON)
+- ``GET  /debug/flightrecorder``  black-box ring: recent events + state
+                                  snapshots (auto-dumped on watchdog trip,
+                                  group-abort, SIGTERM drain)
 - ``POST /debug/profile``         jax.profiler capture of live traffic
+
+Fleet tracing: an inbound ``x-kgct-request-id`` (the router's mint) is
+adopted as the ENGINE request id — the lifecycle tracer's events then
+share the id with the router's span stream — and every /v1 response
+echoes the id, success or error (serving/errors.py owns the header
+contract).
 
 Completion bodies may carry ``session_id`` (or OpenAI's ``user``) — scalar
 affinity keys the prefix-affinity router (serving/router.py) peeks at to
@@ -52,6 +61,7 @@ from ..resilience import (AdmissionController, DrainState, ResilienceHub,
 from ..resilience.drain import drain_and_notify
 from ..utils import get_logger
 from .async_engine import AsyncLLMEngine
+from .errors import REQUEST_ID_HEADER, valid_request_id
 from .errors import overloaded_error as _overloaded
 from .metrics import Metrics
 from .tokenizer import (IncrementalDetokenizer, Tokenizer,
@@ -123,7 +133,12 @@ class APIServer:
         res = resilience or ResilienceConfig()
         self.res_config = res
         self.drain_state = DrainState()
-        self.watchdog = StepWatchdog(timeout_s=res.watchdog_timeout_s)
+        # Watchdog trips auto-dump the flight recorder: the ring holds the
+        # seconds that preceded the hang (queue depths, last scheduled
+        # requests, pool occupancy) — exactly what the postmortem needs
+        # after kubelet restarts the pod.
+        self.watchdog = StepWatchdog(timeout_s=res.watchdog_timeout_s,
+                                     on_trip=self._on_watchdog_trip)
         self.admission = AdmissionController(
             engine.engine, default_budget_ms=res.default_ttft_budget_ms,
             quantile=res.admission_quantile)
@@ -131,21 +146,55 @@ class APIServer:
                                  self.drain_state)
         # The worker thread arms/disarms the watchdog around each step().
         engine.watchdog = self.watchdog
+        # SLO layer grades against the SAME bar admission control sheds on
+        # (None keeps the north-star default inside SLOTracker).
+        engine.engine.obs.slo.ttft_budget_ms = res.default_ttft_budget_ms
+
+    def _on_watchdog_trip(self) -> None:
+        self.engine.engine.obs.flight.dump(
+            "watchdog_trip", trips=self.watchdog.trips,
+            timeout_s=self.watchdog.timeout_s)
 
     # -- app wiring ----------------------------------------------------------
 
     def build_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(middlewares=[self._request_id_mw])
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.prometheus)
         app.router.add_get("/debug/trace", self.trace)
+        app.router.add_get("/debug/flightrecorder", self.flightrecorder)
         app.router.add_post("/debug/profile", self.profile)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
+
+    @web.middleware
+    async def _request_id_mw(self, request: web.Request, handler):
+        """Fleet-tracing correlation: adopt the router-minted
+        ``x-kgct-request-id`` (minting an OpenAI-style id for direct
+        clients) and echo it on every /v1 response — success or error — so
+        a 400/429/503 in a client log joins the engine trace and the JSON
+        log records on one id. The id becomes the ENGINE request id in
+        ``_run``, which is what makes the router's spans and the engine's
+        lifecycle events one end-to-end story. Streaming responses set the
+        header themselves before ``prepare()`` (committed headers cannot be
+        amended here)."""
+        rid = valid_request_id(request.headers.get(REQUEST_ID_HEADER))
+        if rid is None and request.path.startswith("/v1/"):
+            rid = self.engine.next_request_id(
+                "chatcmpl" if "chat" in request.path else "cmpl")
+        request["kgct_request_id"] = rid
+        resp = await handler(request)
+        # Re-read the stash: the duplicate-id guard in _run may have
+        # suffixed the id after this middleware ran — the header must name
+        # the id the engine/trace actually used, not the stale local.
+        final = request.get("kgct_request_id") or rid
+        if final and not resp.prepared:
+            resp.headers[REQUEST_ID_HEADER] = final
+        return resp
 
     async def _on_startup(self, app: web.Application) -> None:
         import asyncio
@@ -166,6 +215,10 @@ class APIServer:
         import asyncio
         if not self.drain_state.start_drain():
             return None
+        # Black-box capture of the pre-drain seconds: what was queued or
+        # mid-stream when the SIGTERM landed outlives the pod in the dump.
+        self.engine.engine.obs.flight.dump(
+            "sigterm_drain", grace_s=self.res_config.drain_grace_s)
         return asyncio.get_running_loop().create_task(drain_and_notify(
             self.drain_state, self.engine,
             grace_s=self.res_config.drain_grace_s, on_drained=on_drained))
@@ -196,6 +249,10 @@ class APIServer:
         retry_after = self.admission.check(budget_ms)
         if retry_after is not None:
             est_ms = round(self.admission.last_estimate_s * 1e3, 1)
+            rid = request.get("kgct_request_id")
+            logger.info("request shed: estimated queue wait %.1f ms over "
+                        "TTFT budget (retry-after %ss)", est_ms, retry_after,
+                        extra={"request_id": rid} if rid else None)
             return _overloaded(
                 429, f"request shed: estimated queue wait {est_ms} ms "
                      f"exceeds the TTFT budget; retry after the backlog "
@@ -233,6 +290,13 @@ class APIServer:
         if request.query.get("clear") in ("1", "true"):
             obs.clear_trace()
         return web.json_response(data)
+
+    async def flightrecorder(self, request: web.Request) -> web.Response:
+        """The engine's black-box ring: recent lifecycle/step events plus
+        periodic state snapshots (queue depths, KV occupancy both tiers).
+        The same ring auto-dumps to a file on watchdog trips, fatal
+        group-aborts, and SIGTERM drain (observability/flightrecorder.py)."""
+        return web.json_response(self.engine.engine.obs.flight.export())
 
     def _detok_push(self, detok: IncrementalDetokenizer, ids, final) -> str:
         """detok.push with its wall time attributed to the ``detokenize``
@@ -359,7 +423,13 @@ class APIServer:
         except (TypeError, ValueError) as e:
             return _error(400, str(e))
         detok = IncrementalDetokenizer(self.tokenizer, stop=_stops(body))
-        rid = self.engine.next_request_id(
+        # The middleware-adopted correlation id (router-minted or inbound)
+        # IS the engine request id — the lifecycle tracer's events then
+        # share the id with the router's span stream end-to-end. The
+        # duplicate-id guard lives at the reservation below (atomic on the
+        # event loop), not here: there are awaits between this point and
+        # the engine submission.
+        rid = request.get("kgct_request_id") or self.engine.next_request_id(
             "cmpl" if kind == "completion" else "chatcmpl")
         created = int(time.time())
         stream = bool(body.get("stream"))
@@ -387,6 +457,16 @@ class APIServer:
                                      best_of=best_of, n_lp=n_lp)
         self.metrics.on_request()
 
+        # Duplicate-id guard, atomic with the submission (no await between
+        # reserve and generate): a client reusing an in-flight correlation
+        # id gets a unique suffix instead of crossing output streams. Loop:
+        # the suffixed id is client-predictable too (monotonic counter), so
+        # a pre-claimed suffix must re-roll, never proceed unowned.
+        if not self.engine.reserve_request_id(rid):
+            base = rid
+            while not self.engine.reserve_request_id(rid):
+                rid = f"{base}+{self.engine.next_request_id('dup')}"
+            request["kgct_request_id"] = rid   # middleware echoes final id
         # ``complete`` guards the engine-side abort: any early handler exit —
         # asyncio.CancelledError when aiohttp cancels the task on client
         # disconnect, ConnectionResetError mid-SSE-write, any bug — must stop
@@ -404,7 +484,10 @@ class APIServer:
                 self.metrics.on_finish(0)  # a 400 is still a delivered response
                 return _error(400, str(e))
             finally:
-                if not complete:
+                # Release FIRST: if the reservation was never consumed the
+                # engine never saw the request, and an abort here would be
+                # a stale poison pill for a later request reusing the id.
+                if not self.engine.release_reservation(rid) and not complete:
                     self.engine.abort(rid)
             self.metrics.on_finish(n_out)
             if echo:
@@ -421,13 +504,19 @@ class APIServer:
 
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
-            "Cache-Control": "no-cache"})
-        await resp.prepare(request)
-        if echo:
-            await resp.write(_sse(_stream_body(
-                kind, rid, created, self.model_name, echo_prefix, None)))
+            "Cache-Control": "no-cache",
+            # Streaming commits headers at prepare(): the correlation id
+            # must ride here — the middleware cannot amend them later.
+            REQUEST_ID_HEADER: rid})
         n_out = 0
         try:
+            # prepare() and the echo frame sit INSIDE the cleanup scope: a
+            # client that disconnects right here would otherwise strand the
+            # reserved id (and, once the generator started, the request).
+            await resp.prepare(request)
+            if echo:
+                await resp.write(_sse(_stream_body(
+                    kind, rid, created, self.model_name, echo_prefix, None)))
             async for chunk in gen:
                 n_out = len(chunk.output_token_ids)
                 delta = self._detok_push(detok, chunk.new_token_ids,
@@ -467,7 +556,10 @@ class APIServer:
             complete = True
             await resp.write(_sse({"error": {"message": str(e), "code": 400}}))
         finally:
-            if not complete:
+            # Release first (see the non-stream path): a reservation that
+            # generate() never consumed means nothing reached the engine —
+            # aborting would poison a later request reusing the same id.
+            if not self.engine.release_reservation(rid) and not complete:
                 self.engine.abort(rid)
         self.metrics.on_finish(n_out)
         await resp.write(b"data: [DONE]\n\n")
@@ -494,6 +586,11 @@ class APIServer:
         run_params = (dataclasses.replace(params, logprobs=True)
                       if best_of > n and not params.logprobs else params)
 
+        # Actual engine ids per child (post duplicate-suffix): the error
+        # path must abort THESE — reconstructing f"{rid}-{i}" could name a
+        # concurrent same-correlation-id request's live generations.
+        subs: list = [None] * best_of
+
         async def one(i):
             sub = f"{rid}-{i}"
             detok = IncrementalDetokenizer(self.tokenizer, stop=_stops(body))
@@ -505,6 +602,14 @@ class APIServer:
             if params.seed is not None and i > 0:
                 p_i = dataclasses.replace(
                     run_params, seed=(params.seed + i) & 0x7fffffff)
+            # Same duplicate-id discipline as _run: two concurrent n>1
+            # requests reusing one correlation id spawn identical sub ids,
+            # and the reservation (atomic with generate, no await between)
+            # keeps their output queues from crossing.
+            base = sub
+            while not self.engine.reserve_request_id(sub):
+                sub = f"{base}+{self.engine.next_request_id('dup')}"
+            subs[i] = sub
             gen = self.engine.generate(sub, list(ids), p_i)
             complete = False
             try:
@@ -512,7 +617,7 @@ class APIServer:
                 complete = True
                 return out
             finally:
-                if not complete:
+                if not self.engine.release_reservation(sub) and not complete:
                     self.engine.abort(sub)
 
         # return_exceptions so one failing child never leaves siblings
@@ -524,8 +629,8 @@ class APIServer:
         errors = [r for r in results if isinstance(r, BaseException)]
         if errors:
             for i, r in enumerate(results):
-                if not isinstance(r, BaseException):
-                    self.engine.abort(f"{rid}-{i}")
+                if not isinstance(r, BaseException) and subs[i] is not None:
+                    self.engine.abort(subs[i])
             self.metrics.on_finish(0)
             if all(isinstance(e, ValueError) for e in errors):
                 return _error(400, str(errors[0]))

@@ -56,6 +56,11 @@ class AsyncLLMEngine:
         self.watchdog = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._queues: dict[str, asyncio.Queue] = {}
+        # Ids reserved via reserve_request_id whose generate() has not
+        # started yet — lets release_reservation() free a slot the handler
+        # abandoned (client died between reserve and first iteration)
+        # without ever touching a live generator's queue.
+        self._reserved: set = set()
         self._inbox: list = []            # (request_id, token_ids, params)
         self._aborts: list[str] = []
         self._cv = threading.Condition()
@@ -94,11 +99,60 @@ class AsyncLLMEngine:
     def next_request_id(self, prefix: str = "cmpl") -> str:
         return f"{prefix}-{next(self._counter)}"
 
+    def reserve_request_id(self, request_id: str) -> bool:
+        """Atomically claim ``request_id``'s output-queue slot (False if a
+        live request already holds it). Synchronous on the event-loop
+        thread — no await between check and claim — so the API layer calls
+        this immediately before ``generate()`` and a concurrent duplicate
+        of a client-supplied correlation id can never cross streams (an
+        async-generator-side check would only run at first iteration,
+        AFTER the caller's awaits — the TOCTOU this closes). Callers must
+        pair it with :meth:`release_reservation` on every handler exit
+        path, or an abandoned reservation would mark the id in-flight
+        forever."""
+        if request_id in self._queues:
+            return False
+        self._queues[request_id] = asyncio.Queue()
+        self._reserved.add(request_id)
+        return True
+
+    def release_reservation(self, request_id: str) -> bool:
+        """Free a reservation whose ``generate()`` never STARTED (the
+        handler died between reserve and the generator's first iteration —
+        e.g. ``resp.prepare`` raising on client disconnect). A no-op once
+        the generator consumed the reservation: its own finally owns the
+        queue's lifetime from then on.
+
+        Returns True when a reservation WAS released — the engine never saw
+        the request, so the caller must NOT enqueue an abort for it: a
+        stale abort of a reused client-supplied id would terminate (or
+        orphan) a LATER request that legitimately claims the same id."""
+        if request_id in self._reserved:
+            self._reserved.discard(request_id)
+            self._queues.pop(request_id, None)
+            return True
+        return False
+
     async def generate(self, request_id: str, prompt_token_ids: list[int],
                        params: SamplingParams) -> AsyncIterator[StreamChunk]:
-        """Submit a request and yield StreamChunks until finished."""
-        queue: asyncio.Queue = asyncio.Queue()
-        self._queues[request_id] = queue
+        """Submit a request and yield StreamChunks until finished.
+
+        Id contract: serving callers reserve the id first (see
+        reserve_request_id, looped until owned); a DIRECT caller must use
+        an id it knows to be unique — calling with an id that has a
+        pending reservation would consume the reserver's slot (there is
+        one namespace, no per-claimant tokens)."""
+        if request_id in self._reserved:
+            # Consume the slot reserve_request_id claimed for us.
+            self._reserved.discard(request_id)
+            queue: asyncio.Queue = self._queues[request_id]
+        else:
+            # Direct (unreserved) callers keep the pre-reservation
+            # semantics: a FRESH queue, overwriting any collision — two
+            # consumers must never share one queue (the old consumer
+            # orphans, exactly as before the reservation seam existed).
+            queue = asyncio.Queue()
+            self._queues[request_id] = queue
         with self._cv:
             self._inbox.append((request_id, prompt_token_ids, params))
             self._cv.notify()
@@ -195,6 +249,10 @@ class AsyncLLMEngine:
                         self._post(_chunk_of(out))
                 except Exception as e:  # engine wedged: fail all waiters
                     logger.exception("engine step failed")
+                    # Black-box dump: the ring holds the requests/steps that
+                    # led here; the pod restarts, the evidence does not.
+                    self.engine.obs.flight.dump("engine_step_failed",
+                                                error=str(e))
                     if wd is not None:
                         # The loop is about to die: /health must STAY 503
                         # (a disarm here would resurrect health on a server

@@ -4,11 +4,36 @@ One definition for engine shed/drain (api_server) and router-level
 rejections: the router's docstring promises clients parse the SAME envelope
 from both layers, so the shape lives in one place instead of drifting
 between two copies.
+
+Also home of the ``x-kgct-request-id`` wire contract (the fleet tracing
+correlation id): the router mints one per request (honoring an inbound
+header), forwards it to the replica, and echoes it on EVERY response —
+success or error — so a 429/503 in a client log joins the router span
+stream, the replica's engine trace, and the JSON log records on one id.
+Defined here because both the router (jax-free process) and the api_server
+import this module already.
 """
 
 from __future__ import annotations
 
+import re
+from typing import Optional
+
 from aiohttp import web
+
+REQUEST_ID_HEADER = "x-kgct-request-id"
+
+# Ids must be safe to echo into headers, log records, and trace JSON: a
+# bounded charset, no whitespace/control bytes, bounded length. Anything
+# else is treated as absent and a fresh id is minted.
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._:+-]{0,127}$")
+
+
+def valid_request_id(rid: Optional[str]) -> Optional[str]:
+    """``rid`` when it satisfies the header contract, else None."""
+    if rid and _REQUEST_ID_RE.match(rid):
+        return rid
+    return None
 
 
 def overloaded_error(status: int, message: str,

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import time
 
+from ..engine.engine import device_memory_stats
+
 
 class Metrics:
     def __init__(self, engine):
@@ -115,6 +117,23 @@ class Metrics:
             f"kgct_kv_host_pages_total {host_total}",
             "# TYPE kgct_kv_host_pages_in_use gauge",
             f"kgct_kv_host_pages_in_use {host_used}",
+        ]
+        # Device telemetry (ROADMAP 4(b) autoscaler inputs): HBM occupancy
+        # straight from the jax runtime's allocator counters (0/0 on CPU —
+        # nan-free), and the jit-cache entry count across every step program
+        # (the tier-1 compile guard's number; flat in steady state, growth
+        # under constant traffic = recompilation storm). The jit series is
+        # a GAUGE despite the _total spelling: it reads the live cache, so
+        # jax.clear_caches()/engine rebuild can shrink it — a counter TYPE
+        # would make rate() report a phantom compile storm on any reset.
+        hbm_limit, hbm_in_use = device_memory_stats()
+        lines += [
+            "# TYPE kgct_hbm_bytes_limit gauge",
+            f"kgct_hbm_bytes_limit {hbm_limit}",
+            "# TYPE kgct_hbm_bytes_in_use gauge",
+            f"kgct_hbm_bytes_in_use {hbm_in_use}",
+            "# TYPE kgct_jit_compiles_total gauge",
+            f"kgct_jit_compiles_total {eng.compiled_step_variants()}",
         ]
         # Histograms (TTFT/TPOT/queue-wait/prefill/step/batch-size/e2e),
         # per-phase step-time counters, and the sampled-decode-ratio gauge —

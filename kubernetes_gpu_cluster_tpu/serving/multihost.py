@@ -266,6 +266,14 @@ def group_abort(engine: LLMEngine) -> int:
     # that predate the two-tier cache.
     seqs = (list(engine.scheduler.waiting) + list(engine.scheduler.running)
             + list(getattr(engine.scheduler, "swapped", ())))
+    # Black-box dump BEFORE the abort flood: the flight recorder's ring
+    # still holds the directives/steps that led to the group failure, and
+    # the rank is about to exit or restart. getattr keeps duck-typed test
+    # engines working.
+    obs = getattr(engine, "obs", None)
+    flight = getattr(obs, "flight", None)
+    if flight is not None:
+        flight.dump("group_abort", requests=len(seqs))
     for seq in seqs:
         try:
             engine.abort_request(seq.request_id)

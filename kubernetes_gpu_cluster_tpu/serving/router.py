@@ -37,7 +37,18 @@ equivalent: an aiohttp reverse proxy that
 - hardens every upstream call: per-attempt connect timeouts, a per-read
   stall timeout that circuit-breaks replicas whose in-flight streams hang,
   and bounded exponential-backoff retry of connect-phase failures (the only
-  phase where nothing reached the upstream, so re-sending is safe).
+  phase where nothing reached the upstream, so re-sending is safe),
+- traces every request: the router mints ``x-kgct-request-id`` (honoring an
+  inbound header), forwards it to the replica — whose api_server adopts it
+  as the ENGINE request id, so the engine's lifecycle trace shares the id —
+  and echoes it on every response, success or error. Its own span stream
+  (pick with policy/owner attribution, connect retries, upstream TTFB,
+  stream relay) lands in a request tracer mirrored into a black-box flight
+  recorder; ``GET /debug/trace`` merges the router's spans with each
+  healthy replica's ``/debug/trace`` (bounded per-replica fetches, same
+  straggler discipline as the metrics scrape) into ONE Perfetto timeline
+  with per-process tracks, and ``GET /debug/flightrecorder`` exposes the
+  crash-capture ring.
 
 Every pick path — first attempt, connect-phase retry-with-exclude, the
 desperation rounds over benched replicas — flows through the single
@@ -65,16 +76,20 @@ import hashlib
 import json
 import math
 import time
+import uuid
 from typing import Optional
 
 import aiohttp
 from aiohttp import web
 
+from ..observability.flightrecorder import FlightRecorder
+from ..observability.trace import RequestTracer, merge_perfetto
 from ..resilience.faults import get_injector as _get_injector
 from ..resilience.faults import inject as _inject_fault
 from ..utils import get_logger
 # The engine's shed/drain responses use the same envelope (serving.errors):
 # a router-level 503 is handled by the identical client code path.
+from .errors import REQUEST_ID_HEADER, valid_request_id
 from .errors import overloaded_error as _proxy_error
 
 logger = get_logger("serving.router")
@@ -177,7 +192,8 @@ class Router:
                  routing_policy: str = "least-inflight",
                  affinity_prefix_len: int = 32,
                  balance_factor: float = 1.5,
-                 ring_vnodes: int = RING_VNODES):
+                 ring_vnodes: int = RING_VNODES,
+                 trace_timeout_s: float = 5.0):
         if routing_policy not in ("least-inflight", "prefix-affinity"):
             raise ValueError(f"unknown routing_policy {routing_policy!r} "
                              "(known: least-inflight, prefix-affinity)")
@@ -221,6 +237,22 @@ class Router:
         self.bench_cooldown_s = bench_cooldown_s
         self.retries_total = 0
         self.scrape_errors_total = 0
+        # Fleet tracing: the router's own span stream (pick / connect_retry
+        # / ttfb / relay per request id) mirrored into the black-box flight
+        # recorder; /debug/trace merges it with replica traces. Bounded
+        # per-replica trace fetches (trace_timeout_s) reuse the metrics-
+        # scrape straggler discipline: skipped and counted, never hung on.
+        self.flight = FlightRecorder()
+        self.flight.set_snapshot_source(self._flight_snapshot)
+        # enabled=None: the tracer resolves the KGCT_TRACE kill switch
+        # itself (one definition, shared with the engine's Observability).
+        self.tracer = RequestTracer(capacity=4096, recorder=self.flight)
+        self.trace_timeout_s = trace_timeout_s
+        self.trace_scrape_errors_total = 0
+        # Classification of the LAST _pick (affinity hit/overflow/remap or
+        # least-inflight fallback), read by proxy() for the "pick" span —
+        # produced inside the seam so the span always matches the counters.
+        self._pick_info: dict = {}
         # Tied-least-inflight tie-break: a plain counter starting at 0, so
         # the choice is a pure function of (config, pick sequence) — two
         # routers replaying the same request sequence pick identically, and
@@ -239,6 +271,8 @@ class Router:
         app.router.add_post("/v1/completions", self.proxy)
         app.router.add_post("/v1/chat/completions", self.proxy)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/debug/trace", self.debug_trace)
+        app.router.add_get("/debug/flightrecorder", self.debug_flightrecorder)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -273,6 +307,18 @@ class Router:
             await asyncio.sleep(self.health_interval_s)
             await asyncio.gather(*(self._check(r) for r in self.replicas),
                                  return_exceptions=True)
+            # Flight-recorder fleet snapshot (per-replica inflight/health)
+            # rides the existing periodic loop — no extra timer.
+            self.flight.maybe_snapshot()
+
+    def _flight_snapshot(self) -> dict:
+        """O(1) state reader for the flight recorder: the router's view of
+        fleet load at this instant (attribute reads only)."""
+        return {
+            "inflight": {r.url: r.inflight for r in self.replicas},
+            "healthy": [r.url for r in self.replicas if r.healthy],
+            "retries_total": self.retries_total,
+        }
 
     async def _check(self, replica: Replica, startup: bool = False) -> None:
         try:
@@ -376,7 +422,10 @@ class Router:
             1 for res in fetched if isinstance(res, BaseException))
         lines += ["# TYPE kgct_router_metrics_scrape_errors_total counter",
                   "kgct_router_metrics_scrape_errors_total "
-                  f"{self.scrape_errors_total}"]
+                  f"{self.scrape_errors_total}",
+                  "# TYPE kgct_router_trace_scrape_errors_total counter",
+                  "kgct_router_trace_scrape_errors_total "
+                  f"{self.trace_scrape_errors_total}"]
         # Fleet locality readout: fold each replica's scraped prefix-cache
         # hit ratio and swapped-sequence count into router-OWNED labeled
         # gauges, so "is affinity concentrating locality" is one scrape of
@@ -462,6 +511,42 @@ class Router:
                 out.append((family, False, f"{base}{{{label}}} {rest}"))
         return out
 
+    # -- fleet tracing -------------------------------------------------------
+
+    async def debug_trace(self, request: web.Request) -> web.Response:
+        """ONE Perfetto timeline for the whole fleet: the router's own span
+        stream (pid 1) merged with each healthy replica's ``/debug/trace``
+        (one pid per replica), re-based onto a common clock via the
+        ``kgctT0Unix`` anchors. A request that crossed router -> replica ->
+        engine step phases renders as correlated spans across the process
+        tracks, keyed by the router-minted request id. Each per-replica
+        fetch is bounded (``trace_timeout_s``) — a stalled replica is
+        skipped and counted in kgct_router_trace_scrape_errors_total, same
+        discipline as the metrics scrape."""
+        docs = [("kgct-router", self.tracer.export_perfetto())]
+        scraped = [r for r in self.replicas if r.healthy]
+        fetched = await asyncio.gather(
+            *(self._fetch_trace(r) for r in scraped),
+            return_exceptions=True)
+        for replica, res in zip(scraped, fetched):
+            if isinstance(res, BaseException) or not isinstance(res, dict):
+                self.trace_scrape_errors_total += 1
+                continue
+            docs.append((f"kgct-engine {replica.url}", res))
+        return web.json_response(merge_perfetto(docs))
+
+    async def _fetch_trace(self, replica: Replica) -> dict:
+        async with self._session.get(
+                f"{replica.url}/debug/trace",
+                timeout=aiohttp.ClientTimeout(total=self.trace_timeout_s)
+                ) as resp:
+            return await resp.json()
+
+    async def debug_flightrecorder(self, request: web.Request) -> web.Response:
+        """The router's black-box ring: recent spans + periodic fleet
+        snapshots (per-replica inflight/health)."""
+        return web.json_response(self.flight.export())
+
     # -- proxying ------------------------------------------------------------
 
     def _pick(self, exclude: Optional[set] = None,
@@ -480,6 +565,7 @@ class Router:
         healthy = [r for r in self.replicas
                    if (r.healthy or include_unhealthy)
                    and (not exclude or r.url not in exclude)]
+        self._pick_info = {"policy": self.routing_policy, "pick": "none"}
         if not healthy:
             return None
         if (affinity_key is not None
@@ -502,18 +588,25 @@ class Router:
                 if replica.inflight + 1 <= bound:
                     if url == owner_url:
                         self.affinity_hits_total += 1
+                        self._pick_info["pick"] = "affinity_hit"
                     elif owner_url in candidates:
                         # Owner was available but over-bound: the hot-key
                         # spillover the balance factor exists to allow.
                         self.affinity_overflow_total[owner_url] = (
                             self.affinity_overflow_total.get(owner_url, 0)
                             + 1)
+                        self._pick_info["pick"] = "affinity_overflow"
+                        self._pick_info["owner"] = owner_url
+                    else:
+                        self._pick_info["pick"] = "affinity_remap"
+                        self._pick_info["owner"] = owner_url
                     return replica
             # Every candidate over-bound: saturation, not a routing failure.
         least = min(r.inflight for r in healthy)
         tied = [r for r in healthy if r.inflight == least]
         seq = self._pick_seq
         self._pick_seq += 1
+        self._pick_info["pick"] = "least_inflight"
         return tied[seq % len(tied)]
 
     def _affinity_key(self, body: bytes) -> Optional[bytes]:
@@ -577,9 +670,21 @@ class Router:
         backoff. Upstream errors after the body was delivered return 502;
         after streaming to the client started, the stream is terminated
         (truncation is the signal) and the stall/death circuit-breaks the
-        replica. Client-side disconnects never count against the replica."""
+        replica. Client-side disconnects never count against the replica.
+
+        Correlation id: an inbound ``x-kgct-request-id`` is honored (header
+        contract: bounded charset/length, else a fresh id is minted), sent
+        upstream — the replica adopts it as its engine request id — and
+        echoed on EVERY response including 429/502/503, so a failed request
+        in a client log joins the router spans, the replica trace, and the
+        JSON log records on one id."""
         body = await request.read()
+        rid = valid_request_id(request.headers.get(REQUEST_ID_HEADER))
+        if rid is None:
+            rid = "req-" + uuid.uuid4().hex[:20]
         akey = self._affinity_key(body)
+        self.tracer.emit("arrival", rid, path=request.path,
+                         policy=self.routing_policy, bytes=len(body))
         tried: set[str] = set()
         last_err: Optional[Exception] = None
         connect_failed = False
@@ -594,6 +699,12 @@ class Router:
             replica = self._pick(exclude=tried,
                                  include_unhealthy=rounds > 0,
                                  affinity_key=akey)
+            # Consume the pick classification SYNCHRONOUSLY (no await may
+            # sit between the _pick call and this copy): _pick overwrites
+            # the shared attribute on its next call, and in an async server
+            # a deferred read would attribute one request's affinity
+            # hit/overflow/remap to another request's span.
+            pick_info = dict(self._pick_info)
             if replica is None:
                 # Every candidate this round failed at connect: nothing was
                 # sent anywhere, so a bounded backed-off re-probe of the
@@ -607,17 +718,25 @@ class Router:
                     continue
                 break
             tried.add(replica.url)
+            self.tracer.emit("pick", rid, replica=replica.url,
+                             attempt=len(tried), round=rounds, **pick_info)
             replica.inflight += 1
             try:
                 try:
                     if _inject_fault("router_connect"):
                         raise ConnectionRefusedError(
                             "KGCT_FAULT router_connect")
+                    fwd_headers = {
+                        k: v for k, v in request.headers.items()
+                        if k.lower() not in HOP_HEADERS
+                        and k.lower() != REQUEST_ID_HEADER}
+                    # The replica adopts this as its engine request id, so
+                    # its lifecycle trace correlates with the router spans.
+                    fwd_headers[REQUEST_ID_HEADER] = rid
+                    t_attempt = time.monotonic()
                     upstream_cm = self._session.request(
                         request.method, f"{replica.url}{request.path_qs}",
-                        data=body if body else None,
-                        headers={k: v for k, v in request.headers.items()
-                                 if k.lower() not in HOP_HEADERS})
+                        data=body if body else None, headers=fwd_headers)
                     # Headers deadline: a replica that accepted the request
                     # and then never responds at all is wedged — but the
                     # bound is the generous response_timeout_s, because a
@@ -625,13 +744,19 @@ class Router:
                     # until the whole generation finishes.
                     upstream = await asyncio.wait_for(
                         upstream_cm.__aenter__(), self.response_timeout_s)
+                    self.tracer.emit(
+                        "ttfb", rid, replica=replica.url,
+                        status=upstream.status,
+                        ms=round((time.monotonic() - t_attempt) * 1e3, 2))
                 except CONNECT_PHASE_ERRORS as e:
                     # TCP connect failed or timed out: nothing reached the
                     # upstream — safe to fail over.
                     last_err = e
                     connect_failed = True
                     self.retries_total += 1
-                    self._count_failure(replica, e)
+                    self.tracer.emit("connect_retry", rid,
+                                     replica=replica.url, error=str(e))
+                    self._count_failure(replica, e, request_id=rid)
                     continue
                 except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                     # Request sent (at least partially) but no response —
@@ -639,14 +764,22 @@ class Router:
                     # silent past stall_timeout_s: the upstream may already
                     # be processing it — do NOT re-send.
                     last_err = e
-                    self._count_failure(replica, e)
+                    self._count_failure(replica, e, request_id=rid)
                     break
                 try:
                     resp = web.StreamResponse(status=upstream.status)
                     for k, v in upstream.headers.items():
                         if k.lower() not in HOP_HEADERS:
                             resp.headers[k] = v
+                    # Prefer the replica's echoed id (already copied above):
+                    # its engine may have SUFFIXED a duplicate (rid+dup-N),
+                    # and the header must name the id the engine trace and
+                    # response body actually use. A non-kgct upstream that
+                    # echoed nothing gets our mint.
+                    if REQUEST_ID_HEADER not in resp.headers:
+                        resp.headers[REQUEST_ID_HEADER] = rid
                     await resp.prepare(request)
+                    relayed = 0
                     while True:
                         try:
                             if _inject_fault("replica_hang"):
@@ -666,7 +799,10 @@ class Router:
                             # replica; the client stream is already
                             # committed — terminate it (truncation is the
                             # signal).
-                            self._count_failure(replica, e)
+                            self._count_failure(replica, e, request_id=rid)
+                            self.tracer.emit("abort", rid,
+                                             reason="upstream_stall",
+                                             error=str(e), bytes=relayed)
                             with contextlib.suppress(Exception):
                                 await resp.write_eof()
                             return resp
@@ -674,30 +810,51 @@ class Router:
                             break
                         try:
                             await resp.write(chunk)
+                            relayed += len(chunk)
                         except (ConnectionError, aiohttp.ClientError):
                             # CLIENT went away — not the replica's fault; no
                             # failure accounting.
+                            self.tracer.emit("abort", rid,
+                                             reason="client_disconnect",
+                                             bytes=relayed)
                             return resp
                     await resp.write_eof()
+                    self.tracer.emit("relay", rid, bytes=relayed)
+                    self.tracer.emit("finish", rid, status=upstream.status,
+                                     replica=replica.url)
                     return resp
                 finally:
                     await upstream_cm.__aexit__(None, None, None)
             finally:
                 replica.inflight -= 1
         if last_err is not None:
-            return _proxy_error(502, f"upstream error: {last_err}",
+            self.tracer.emit("abort", rid, reason="upstream_error",
+                             error=str(last_err))
+            logger.warning("proxy failed after %d replicas: %s", len(tried),
+                           last_err, extra={"request_id": rid})
+            resp = _proxy_error(502, f"upstream error: {last_err}",
                                 retry_after_s=1)
-        return _proxy_error(
+            resp.headers[REQUEST_ID_HEADER] = rid
+            return resp
+        self.tracer.emit("abort", rid, reason="no_healthy_replicas")
+        logger.warning("no healthy replicas for request",
+                       extra={"request_id": rid})
+        resp = _proxy_error(
             503, "no healthy replicas; retry shortly",
             retry_after_s=max(int(self.health_interval_s), 1))
+        resp.headers[REQUEST_ID_HEADER] = rid
+        return resp
 
-    def _count_failure(self, replica: Replica, err: Exception) -> None:
+    def _count_failure(self, replica: Replica, err: Exception,
+                       request_id: str = "") -> None:
         replica.consecutive_failures += 1
         if replica.consecutive_failures >= self.fail_threshold:
             replica.healthy = False
             replica.benched_until = time.monotonic() + self.bench_cooldown_s
             logger.warning("replica %s marked unhealthy for >= %.0fs (%s)",
-                           replica.url, self.bench_cooldown_s, err)
+                           replica.url, self.bench_cooldown_s, err,
+                           extra=({"request_id": request_id}
+                                  if request_id else None))
 
 
 

@@ -177,10 +177,52 @@ class TestWatchdog:
             raise AssertionError("health did not recover after stall ended")
         loop.run_until_complete(go())
 
+    def test_watchdog_trip_dumps_flight_recorder(self, chaos_client,
+                                                 monkeypatch, tmp_path):
+        """A watchdog trip auto-dumps the black-box flight recorder: the
+        file holds the triggering event plus the ring of events/snapshots
+        that preceded the hang (the ISSUE's crash-capture contract)."""
+        loop, client, server = chaos_client
+        monkeypatch.setenv("KGCT_FLIGHT_DIR", str(tmp_path))
+
+        async def go():
+            configure_faults("step_stall:delay=0.6,times=1")
+            task = asyncio.get_event_loop().create_task(
+                _complete(client, max_tokens=2))
+            dump = None
+            for _ in range(80):
+                dumps = sorted(tmp_path.glob("flight-watchdog_trip-*.json"))
+                if dumps:
+                    dump = dumps[0]
+                    break
+                await asyncio.sleep(0.025)
+            r = await task
+            assert r.status == 200
+            assert dump is not None, "watchdog trip produced no dump"
+            doc = json.loads(dump.read_text())
+            assert doc["reason"] == "watchdog_trip"
+            kinds = [e["kind"] for e in doc["events"]]
+            assert "watchdog_trip" in kinds          # the trigger itself
+            # The preceding seconds: lifecycle events and at least one
+            # periodic state snapshot (queue depths / KV occupancy) from
+            # the module's earlier traffic.
+            assert "snapshot" in kinds
+            snap = next(e for e in doc["events"] if e["kind"] == "snapshot")
+            assert {"waiting", "running", "kv_pages_free"} <= set(snap)
+            # Health recovers (the stall was transient).
+            for _ in range(40):
+                if (await client.get("/health")).status == 200:
+                    return
+                await asyncio.sleep(0.02)
+            raise AssertionError("health did not recover after stall ended")
+        loop.run_until_complete(go())
+
 
 class TestGracefulDrain:
-    def test_drain_finishes_inflight_and_rejects_new(self, chaos_client):
+    def test_drain_finishes_inflight_and_rejects_new(self, chaos_client,
+                                                     monkeypatch, tmp_path):
         loop, client, server = chaos_client
+        monkeypatch.setenv("KGCT_FLIGHT_DIR", str(tmp_path))
 
         async def go():
             r = await client.post("/v1/completions", json={
@@ -193,6 +235,10 @@ class TestGracefulDrain:
             task = server.begin_drain(on_drained=lambda: drained.append(1))
             assert task is not None
             assert server.begin_drain() is None     # idempotent
+            # Drain start auto-dumped the flight recorder (what was queued
+            # or mid-stream when the SIGTERM landed outlives the pod).
+            [dump] = sorted(tmp_path.glob("flight-sigterm_drain-*.json"))
+            assert json.loads(dump.read_text())["reason"] == "sigterm_drain"
             # New admissions are rejected with the OpenAI envelope...
             r2 = await _complete(client)
             assert r2.status == 503
@@ -294,8 +340,9 @@ def leader_client():
 
 class TestMultihostLeader:
     def test_broadcast_fail_group_aborts_and_leader_stays_serveable(
-            self, leader_client):
+            self, leader_client, monkeypatch, tmp_path):
         loop, client, server = leader_client
+        monkeypatch.setenv("KGCT_FLIGHT_DIR", str(tmp_path))
 
         async def go():
             # Healthy lockstep first: broadcasts reach the fake follower.
@@ -317,6 +364,17 @@ class TestMultihostLeader:
             assert server.engine.leader is None
             r3 = await _complete(client)
             assert r3.status == 200
+            # The fatal group-abort auto-dumped the flight recorder with
+            # the triggering event and the in-flight work it found.
+            [dump] = sorted(tmp_path.glob("flight-group_abort-*.json"))
+            doc = json.loads(dump.read_text())
+            assert doc["reason"] == "group_abort"
+            trigger = [e for e in doc["events"]
+                       if e["kind"] == "group_abort"]
+            assert trigger and trigger[-1]["requests"] >= 1
+            # The ring captured the seconds before: the doomed request's
+            # lifecycle events are in the dump.
+            assert any(e["kind"] == "arrival" for e in doc["events"])
         loop.run_until_complete(go())
 
 

@@ -339,10 +339,14 @@ def test_prefix_affinity_renders_per_replica_addressing():
         "http://kgct-qwen3-engine-svc:8000"
 
 
-def test_scrape_annotations_engine_only():
-    """Engine pods carry prometheus.io scrape annotations; router pods must
-    NOT — the router's /metrics re-exports every engine's series (replica-
-    labeled), so scraping both would double-ingest each sample."""
+def test_scrape_annotations_engine_and_router():
+    """Engine pods AND the router pod carry prometheus.io scrape
+    annotations: the router's /metrics is the fleet aggregation point —
+    its own series (affinity hit ratio, per-replica locality gauges,
+    trace/metrics scrape-error counters) exist nowhere else, so an
+    annotation-based Prometheus must discover it too. (Engine families the
+    router re-exports are replica-labeled; dashboards aggregate per scrape
+    job to avoid double counting — README "Observability".)"""
     ms = render_values(copy.deepcopy(VALUES))
     eng_meta = ms["qwen3-engine-deployment.yaml"]["spec"]["template"]["metadata"]
     ann = eng_meta["annotations"]
@@ -350,7 +354,10 @@ def test_scrape_annotations_engine_only():
     assert ann["prometheus.io/port"] == "8000"
     assert ann["prometheus.io/path"] == "/metrics"
     router_meta = ms["router-deployment.yaml"]["spec"]["template"]["metadata"]
-    assert "prometheus.io/scrape" not in (router_meta.get("annotations") or {})
+    rann = router_meta["annotations"]
+    assert rann["prometheus.io/scrape"] == "true"
+    assert rann["prometheus.io/port"] == "8080"
+    assert rann["prometheus.io/path"] == "/metrics"
 
 
 def test_rayspec_renders_statefulset_with_coordinator():

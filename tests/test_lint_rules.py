@@ -541,6 +541,119 @@ class TestRouterPickPath:  # KGCT011
         """, "KGCT011", relpath="engine/fake.py") == []
 
 
+class TestTraceEmitHygiene:  # KGCT012
+    def test_file_io_in_emit_fires(self):
+        found = lint("""
+            class RequestTracer:
+                def emit(self, kind, request_id=""):
+                    with open("/tmp/trace.log", "a") as f:
+                        f.write(kind)
+        """, "KGCT012", relpath="observability/fake.py")
+        assert found and any("open()" in f.message for f in found)
+
+    def test_serialization_and_lock_in_record_fire(self):
+        found = lint("""
+            import json
+
+            class FlightRecorder:
+                def record(self, kind, request_id="", args=None):
+                    with self._lock:
+                        self._ring.append(json.dumps(args))
+        """, "KGCT012", relpath="observability/fake.py")
+        msgs = " ".join(f.message for f in found)
+        assert "json.dumps" in msgs and "lock held" in msgs
+
+    def test_host_sync_in_snapshot_fires(self):
+        found = lint("""
+            class FlightRecorder:
+                def maybe_snapshot(self):
+                    self._ring.append(self._occupancy.item())
+        """, "KGCT012", relpath="observability/fake.py")
+        assert len(found) == 1 and ".item()" in found[0].message
+
+    def test_dump_in_engine_hot_path_fires(self):
+        found = lint("""
+            class FooEngine:
+                def step(self):
+                    outs = self._run()
+                    self.obs.flight.dump("per_step")
+                    return outs
+
+                def _run(self):
+                    return []
+        """, "KGCT012", relpath="engine/fake.py")
+        assert len(found) == 1 and "hot-path" in found[0].message
+
+    def test_export_in_router_proxy_fires(self):
+        found = lint("""
+            class Router:
+                async def proxy(self, request):
+                    doc = self.tracer.export_perfetto()
+                    return doc
+        """, "KGCT012", relpath="serving/fake.py")
+        assert len(found) == 1 and "export" in found[0].message
+
+    def test_awaited_emit_in_serving_fires(self):
+        found = lint("""
+            class Router:
+                async def proxy(self, request):
+                    await self.tracer.emit("arrival", "r1")
+        """, "KGCT012", relpath="serving/fake.py")
+        assert len(found) == 1 and "synchronous" in found[0].message
+
+    def test_append_only_writes_and_offline_dump_are_silent(self):
+        # The shipped shape: emit/record are pure appends; dump/export live
+        # on failure handlers and debug endpoints, off the hot path.
+        assert lint("""
+            import time
+
+            class RequestTracer:
+                def emit(self, kind, request_id="", **args):
+                    rec = self.recorder
+                    if rec is not None:
+                        rec.record(kind, request_id, args)
+                    self._ring.append((time.monotonic(), kind, args))
+
+            class FlightRecorder:
+                def record(self, kind, request_id="", args=None):
+                    self._ring.append((time.monotonic(), kind, args))
+
+                def maybe_snapshot(self):
+                    self._ring.append(self._source())
+
+                def dump(self, reason):
+                    with open("/tmp/x.json", "w") as f:
+                        f.write(reason)
+        """, "KGCT012", relpath="observability/fake.py") == []
+
+    def test_emit_on_hot_path_and_dump_off_it_are_silent(self):
+        # Emitting from step IS the design; dump from a non-step method
+        # (failure handler) is the sanctioned place for I/O.
+        assert lint("""
+            class FooEngine:
+                def step(self):
+                    self.obs.tracer.emit("decode", "", batch=4)
+                    return []
+
+                def on_fatal(self, err):
+                    self.obs.flight.dump("fatal", error=str(err))
+        """, "KGCT012", relpath="engine/fake.py") == []
+
+    def test_outside_scopes_silent(self):
+        # dump on a non-proxy serving handler (debug endpoint): fine.
+        assert lint("""
+            class Router:
+                async def debug_flightrecorder(self, request):
+                    return self.flight.export()
+        """, "KGCT012", relpath="serving/fake.py") == []
+        # unrelated .dump() with no tracer/recorder receiver: out of scope.
+        assert lint("""
+            class FooEngine:
+                def step(self):
+                    return self.checkpointer.dump("state")
+        """, "KGCT012", relpath="engine/fake.py") == []
+
+
 class TestFramework:
     def test_every_rule_has_code_name_description(self):
         codes = [r.code for r in ALL_RULES]

@@ -11,11 +11,13 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from kubernetes_gpu_cluster_tpu.observability import (  # noqa: E402
-    PHASES, Histogram, Observability, render_gauge)
+    PHASES, Histogram, Observability, SLOTracker, render_gauge)
+from kubernetes_gpu_cluster_tpu.observability.flightrecorder import (  # noqa: E402
+    FlightRecorder)
 from kubernetes_gpu_cluster_tpu.observability.phases import (  # noqa: E402
     StepPhaseStats)
 from kubernetes_gpu_cluster_tpu.observability.trace import (  # noqa: E402
-    RequestTracer)
+    RequestTracer, merge_perfetto)
 
 
 class _Seq:
@@ -121,6 +123,140 @@ class TestRequestTracer:
         assert {s["name"] for s in slices} == {"device_dispatch",
                                                "device_fetch"}
         assert all(s["dur"] > 0 for s in slices)
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_and_disable(self):
+        fr = FlightRecorder(capacity=4, enabled=True)
+        for i in range(10):
+            fr.record("queued", f"r{i}", {"n": i})
+        events = fr.export()["events"]
+        assert len(events) == 4 and events[0]["request_id"] == "r6"
+        off = FlightRecorder(enabled=False)
+        off.record("queued", "r0")
+        assert off.export()["events"] == []
+        assert off.dump("anything") is None
+
+    def test_tracer_mirror_is_independent_of_trace_toggle(self):
+        # The flight recorder is the ALWAYS-ON crash capture: KGCT_TRACE=0
+        # (tracer disabled) must not silence it — only KGCT_FLIGHT=0 does.
+        fr = FlightRecorder(enabled=True)
+        tr = RequestTracer(enabled=False, recorder=fr)
+        tr.emit("arrival", "r1", prompt_tokens=8)
+        assert tr.events() == []                      # trace ring: off
+        [ev] = fr.export()["events"]
+        assert ev["kind"] == "arrival" and ev["request_id"] == "r1"
+        assert ev["prompt_tokens"] == 8
+
+    def test_snapshot_source_and_interval(self):
+        fr = FlightRecorder(enabled=True, snapshot_interval_s=0.0)
+        calls = []
+        fr.set_snapshot_source(lambda: calls.append(1) or {"waiting": 3})
+        fr.maybe_snapshot()
+        fr.maybe_snapshot()
+        snaps = [e for e in fr.export()["events"] if e["kind"] == "snapshot"]
+        assert len(snaps) == 2 and snaps[0]["waiting"] == 3
+        # A long interval rate-limits: the second call within the window
+        # is a single monotonic read, no snapshot.
+        slow = FlightRecorder(enabled=True, snapshot_interval_s=3600)
+        slow.set_snapshot_source(lambda: {"waiting": 0})
+        slow.maybe_snapshot()
+        slow.maybe_snapshot()
+        assert len([e for e in slow.export()["events"]
+                    if e["kind"] == "snapshot"]) == 1
+        # A raising source never propagates (the step loop must survive).
+        fr.set_snapshot_source(lambda: 1 / 0)
+        fr.maybe_snapshot()
+
+    def test_dump_writes_trigger_and_ring(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KGCT_FLIGHT_DIR", str(tmp_path))
+        fr = FlightRecorder(enabled=True)
+        fr.record("arrival", "r1", {"prompt_tokens": 4})
+        path = fr.dump("watchdog_trip", trips=2)
+        assert path is not None and path.startswith(str(tmp_path))
+        doc = json.loads(open(path).read())
+        assert doc["reason"] == "watchdog_trip"
+        assert doc["info"] == {"trips": 2}
+        kinds = [e["kind"] for e in doc["events"]]
+        assert kinds == ["arrival", "watchdog_trip"]   # trigger appended
+        assert doc["events"][-1]["trips"] == 2
+        assert fr.dumps_total == 1 and fr.last_dump_path == path
+        # unix anchor converts monotonic event ts to wall clock
+        assert doc["unix_minus_monotonic"] + doc["events"][0]["ts"] > 0
+
+
+class TestSLOTracker:
+    def test_attainment_and_default_budget(self):
+        slo = SLOTracker()                 # no operator budget
+        assert slo.budget_ms == 1000.0     # north-star bar
+        assert slo.attainment() == 1.0     # empty window: nothing missed
+        slo.on_first_token(0.5)
+        slo.on_first_token(0.9)
+        slo.on_first_token(2.0)            # blows the 1 s bar
+        assert abs(slo.attainment() - 2 / 3) < 1e-9
+        slo.ttft_budget_ms = 3000.0        # operator budget overrides
+        assert slo.attainment() == 1.0
+
+    def test_goodput_counts_only_budget_meeting_requests(self):
+        import time as _time
+
+        slo = SLOTracker(ttft_budget_ms=1000.0, goodput_window_s=10.0)
+        assert slo.goodput_tokens_per_sec() == 0.0
+        slo.on_finish(0.5, 40)             # met budget: counts
+        slo.on_finish(5.0, 1000)           # blew budget: excluded
+        slo.on_finish(0.2, 0)              # no tokens: excluded
+        # Simulate a 10 s observed span: the denominator is the observed
+        # elapsed time capped at the window, never the bare window (a
+        # fresh server's goodput must not be systematically understated).
+        slo._window_start = _time.monotonic() - 10.0
+        assert abs(slo.goodput_tokens_per_sec() - 4.0) < 0.01
+        # Short observed span: same tokens over ~2 s reads ~20 tok/s.
+        slo._window_start = _time.monotonic() - 2.0
+        assert abs(slo.goodput_tokens_per_sec() - 20.0) < 0.2
+        slo.clear()
+        assert slo.goodput_tokens_per_sec() == 0.0
+        assert slo.attainment() == 1.0
+
+    def test_window_is_bounded(self):
+        slo = SLOTracker(ttft_budget_ms=1000.0, window=4)
+        for _ in range(10):
+            slo.on_first_token(9.0)        # all misses
+        slo.on_first_token(0.1)            # one recent hit
+        assert abs(slo.attainment() - 1 / 4) < 1e-9
+
+
+class TestMergePerfetto:
+    def _doc(self, rid, t0_unix):
+        tr = RequestTracer()
+        tr.emit("arrival", rid)
+        tr.emit("finish", rid, outcome="finished")
+        doc = tr.export_perfetto(process_name="p")
+        doc["kgctT0Unix"] = t0_unix        # pin the anchor for determinism
+        return doc
+
+    def test_rebase_pid_and_labels(self):
+        a = self._doc("req-1", 100.0)      # earliest process: origin
+        b = self._doc("req-1", 100.5)      # starts 0.5 s later
+        merged = merge_perfetto([("kgct-router", a), ("kgct-engine x", b)])
+        assert merged["kgctT0Unix"] == 100.0
+        names = {e["args"]["name"] for e in merged["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert names == {"kgct-router", "kgct-engine x"}
+        # Both processes carry the request span, correlated on the id...
+        spans = [e for e in merged["traceEvents"]
+                 if e.get("cat") == "request" and e.get("id") == "req-1"]
+        assert {e["pid"] for e in spans} == {1, 2}
+        # ...and the later process's events shifted by its anchor delta.
+        b_open = min(e["ts"] for e in spans if e["pid"] == 2)
+        assert b_open >= 0.5e6 - 1
+        json.dumps(merged)                 # wire-serializable
+
+    def test_empty_doc_merges_without_anchor(self):
+        empty = RequestTracer().export_perfetto()
+        assert empty["kgctT0Unix"] is None
+        merged = merge_perfetto([("a", empty), ("b", self._doc("r", 5.0))])
+        assert merged["kgctT0Unix"] == 5.0
+        assert {e["pid"] for e in merged["traceEvents"]} == {1, 2}
 
 
 class TestStepPhaseStats:
@@ -241,6 +377,26 @@ class TestObservabilityLifecycle:
         text = "\n".join(obs.render_prometheus())
         assert "nan" not in text.lower()
         assert "kgct_step_phase_seconds_total" in text
+
+    def test_aborted_requests_excluded_from_goodput(self):
+        """Goodput counts DELIVERED work: an aborted request's tokens were
+        generated but never received, so they must not inflate the
+        autoscaler signal — a finished request with the same TTFT does."""
+        obs = Observability(enabled=True)
+
+        def run(rid, reason):
+            seq = _Seq(rid)
+            obs.on_arrival(seq)
+            obs.on_scheduled(seq, 1)
+            seq.arrival_time = seq.scheduled_time        # TTFT ~10 ms
+            seq.first_token_time = seq.scheduled_time + 0.01
+            obs.on_first_token(seq)
+            seq.num_output_tokens = 50
+            obs.on_finish(seq, reason)
+        run("ra", "abort")
+        assert obs.slo.goodput_tokens_per_sec() == 0.0
+        run("rb", None)
+        assert obs.slo.goodput_tokens_per_sec() > 0.0
 
 
 class TestJsonLogFormat:

@@ -265,11 +265,19 @@ class TestAffinityKey:
 
 async def _recording_replica(extra_metrics=""):
     """A stand-in engine replica that records served completion requests
-    and streams an SSE body (so stickiness is proven on the STREAMING
-    proxy path — the body-peek must not break passthrough)."""
+    (body + forwarded ``x-kgct-request-id``) and streams an SSE body (so
+    stickiness is proven on the STREAMING proxy path — the body-peek must
+    not break passthrough). Its ``/debug/trace`` mimics a real
+    api_server: a lifecycle span per served request id, exported through
+    the real RequestTracer — what the router's merged fleet trace
+    fetches."""
     from aiohttp import web as aioweb
 
+    from kubernetes_gpu_cluster_tpu.observability.trace import RequestTracer
+    from kubernetes_gpu_cluster_tpu.serving.errors import REQUEST_ID_HEADER
+
     served = []
+    tracer = RequestTracer()
 
     async def health(request):
         return aioweb.json_response({"status": "ok"})
@@ -281,7 +289,12 @@ async def _recording_replica(extra_metrics=""):
             content_type="text/plain")
 
     async def completions(request):
-        served.append(await request.json())
+        rid = request.headers.get(REQUEST_ID_HEADER, "")
+        served.append({"body": await request.json(), "request_id": rid})
+        if rid:
+            tracer.emit("arrival", rid, prompt_tokens=1)
+            tracer.emit("first_token", rid, ttft_ms=1.0)
+            tracer.emit("finish", rid, outcome="finished")
         resp = aioweb.StreamResponse(
             headers={"Content-Type": "text/event-stream"})
         await resp.prepare(request)
@@ -290,9 +303,13 @@ async def _recording_replica(extra_metrics=""):
         await resp.write_eof()
         return resp
 
+    async def debug_trace(request):
+        return aioweb.json_response(tracer.export_perfetto())
+
     app = aioweb.Application()
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/trace", debug_trace)
     app.router.add_post("/v1/completions", completions)
     runner = aioweb.AppRunner(app)
     await runner.setup()
@@ -444,10 +461,185 @@ class TestRouterMetricsAggregation:
                            a_url) == 0.0
                 assert ('kgct_router_policy{policy="least-inflight"} 1'
                         in text)
+                # Fleet-trace scrape accounting: present and zero on a
+                # fresh router.
+                assert "kgct_router_trace_scrape_errors_total 0" in text
             finally:
                 await client.close()
                 await a_runner.cleanup()
                 await b_runner.cleanup()
+        asyncio.run(scenario())
+
+
+class TestRouterRequestId:
+    def test_id_minted_forwarded_and_echoed(self):
+        """The correlation-id contract (satellite 1): every router response
+        carries x-kgct-request-id — minted when absent, honored when the
+        inbound header is valid — and the SAME id is forwarded upstream so
+        the replica can adopt it as its engine request id."""
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            REQUEST_ID_HEADER)
+
+        async def scenario():
+            a_runner, a_url, a_served = await _recording_replica()
+            router = Router([a_url], health_interval_s=9999)
+            client = await _start_router(router)
+            try:
+                # Minted: no inbound header.
+                r = await client.post("/v1/completions",
+                                      json={"prompt": "x"})
+                assert r.status == 200
+                minted = r.headers[REQUEST_ID_HEADER]
+                assert minted.startswith("req-")
+                assert a_served[0]["request_id"] == minted   # forwarded
+                # Honored: a valid inbound id passes through end-to-end.
+                r2 = await client.post(
+                    "/v1/completions", json={"prompt": "y"},
+                    headers={REQUEST_ID_HEADER: "req-client-42"})
+                assert r2.headers[REQUEST_ID_HEADER] == "req-client-42"
+                assert a_served[1]["request_id"] == "req-client-42"
+                # Invalid inbound (spaces) is replaced by a fresh mint.
+                r3 = await client.post(
+                    "/v1/completions", json={"prompt": "z"},
+                    headers={REQUEST_ID_HEADER: "bad id"})
+                assert r3.headers[REQUEST_ID_HEADER].startswith("req-")
+            finally:
+                await client.close()
+                await a_runner.cleanup()
+        asyncio.run(scenario())
+
+    def test_error_responses_carry_id(self):
+        """429/503-class rejections are exactly where correlation matters
+        most (satellite 1's bugfix): a router with no healthy replicas
+        still stamps the id on its 503."""
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            REQUEST_ID_HEADER)
+
+        async def scenario():
+            # Nothing listens on this port: the startup probe benches it.
+            router = Router(["http://127.0.0.1:1"], health_interval_s=9999,
+                            connect_retries=0)
+            client = await _start_router(router)
+            try:
+                r = await client.post(
+                    "/v1/completions", json={"prompt": "x"},
+                    headers={REQUEST_ID_HEADER: "req-err-1"})
+                assert r.status in (502, 503)
+                assert r.headers[REQUEST_ID_HEADER] == "req-err-1"
+                r2 = await client.post("/v1/completions",
+                                       json={"prompt": "x"})
+                assert r2.status in (502, 503)
+                assert r2.headers[REQUEST_ID_HEADER].startswith("req-")
+            finally:
+                await client.close()
+        asyncio.run(scenario())
+
+
+class TestMergedFleetTrace:
+    def test_debug_trace_merges_router_and_replica_spans(self):
+        """The tentpole's single-download contract: GET /debug/trace on the
+        router returns ONE Perfetto doc with the router's spans (pid 1) and
+        each replica's lifecycle spans (pid 2..N), correlated on the
+        router-minted ids, with per-process name metadata."""
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            REQUEST_ID_HEADER)
+
+        async def scenario():
+            a_runner, a_url, _ = await _recording_replica()
+            b_runner, b_url, _ = await _recording_replica()
+            router = Router([a_url, b_url], health_interval_s=9999)
+            client = await _start_router(router)
+            try:
+                # One request pinned to each replica via least-inflight's
+                # deterministic tie-break (inflight 0, seq 0 then 1).
+                for rid in ("req-merge-a", "req-merge-b"):
+                    r = await client.post(
+                        "/v1/completions", json={"prompt": rid},
+                        headers={REQUEST_ID_HEADER: rid})
+                    assert r.status == 200
+                r = await client.get("/debug/trace")
+                assert r.status == 200
+                doc = await r.json()
+            finally:
+                await client.close()
+                await a_runner.cleanup()
+                await b_runner.cleanup()
+
+            evs = doc["traceEvents"]
+            # Three processes, labeled: the router + both replicas.
+            labels = {e["pid"]: e["args"]["name"] for e in evs
+                      if e.get("name") == "process_name"}
+            assert labels[1] == "kgct-router"
+            assert {f"kgct-engine {a_url}", f"kgct-engine {b_url}"} == {
+                labels[2], labels[3]}
+            # Router spans AND replica spans share the minted ids.
+            by_pid = {}
+            for e in evs:
+                if e.get("cat") == "request" and e.get("id"):
+                    by_pid.setdefault(e["pid"], set()).add(e["id"])
+            assert by_pid[1] == {"req-merge-a", "req-merge-b"}
+            assert by_pid[2] | by_pid[3] == {"req-merge-a", "req-merge-b"}
+            # The router's per-request instants carry pick attribution.
+            picks = [e for e in evs if e.get("name") == "pick"]
+            assert picks and all(e["pid"] == 1 for e in picks)
+            assert {e["args"]["replica"] for e in picks} == {a_url, b_url}
+            # Timestamps rebased onto one timeline: all non-meta ts >= 0.
+            assert all(e["ts"] >= 0 for e in evs if "ts" in e)
+            import json as _json
+            _json.dumps(doc)               # wire-serializable
+
+    def test_replica_without_trace_endpoint_is_skipped_and_counted(self):
+        """A replica whose /debug/trace is missing (predates the feature)
+        or stalls must not break the fleet download: it is skipped and
+        counted, and the router's own spans still export."""
+        from aiohttp import web as aioweb
+
+        async def scenario():
+            # Minimal replica: health only — /debug/trace 404s.
+            async def health(request):
+                return aioweb.json_response({"status": "ok"})
+
+            app = aioweb.Application()
+            app.router.add_get("/health", health)
+            runner = aioweb.AppRunner(app)
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            url = f"http://127.0.0.1:{runner.addresses[0][1]}"
+            router = Router([url], health_interval_s=9999)
+            client = await _start_router(router)
+            try:
+                r = await client.get("/debug/trace")
+                assert r.status == 200
+                doc = await r.json()
+                assert router.trace_scrape_errors_total == 1
+                labels = [e["args"]["name"] for e in doc["traceEvents"]
+                          if e.get("name") == "process_name"]
+                assert labels == ["kgct-router"]
+            finally:
+                await client.close()
+                await runner.cleanup()
+        asyncio.run(scenario())
+
+    def test_flightrecorder_endpoint_exports_spans_and_snapshots(self):
+        async def scenario():
+            a_runner, a_url, _ = await _recording_replica()
+            router = Router([a_url], health_interval_s=9999)
+            client = await _start_router(router)
+            try:
+                await client.post("/v1/completions", json={"prompt": "x"})
+                router.flight.maybe_snapshot()   # the health loop's call
+                r = await client.get("/debug/flightrecorder")
+                assert r.status == 200
+                doc = await r.json()
+            finally:
+                await client.close()
+                await a_runner.cleanup()
+            kinds = {e["kind"] for e in doc["events"]}
+            assert {"arrival", "pick", "finish", "snapshot"} <= kinds
+            snap = next(e for e in doc["events"] if e["kind"] == "snapshot")
+            assert a_url in snap["inflight"]
+            assert snap["healthy"] == [a_url]
         asyncio.run(scenario())
 
 

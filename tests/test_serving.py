@@ -377,6 +377,139 @@ class TestObservability:
                                     "first_fetch_ms"))
 
 
+class TestFleetTelemetry:
+    """The device/SLO telemetry layer: new gauges present and nan-free on
+    a scrape of the module's live server (exposition validity overall is
+    pinned by _assert_valid_exposition in test_metrics_endpoint)."""
+
+    def test_new_gauges_present_and_nan_free(self, api_client):
+        loop, client = api_client
+
+        async def go():
+            r = await client.get("/metrics")
+            return await r.text()
+        text = loop.run_until_complete(go())
+        _assert_valid_exposition(text)
+
+        def val(prefix):
+            [line] = [l for l in text.splitlines() if l.startswith(prefix)]
+            return float(line.rpartition(" ")[2])
+
+        # HBM gauges: 0 on CPU (the backend reports nothing), never nan.
+        assert val("kgct_hbm_bytes_limit ") >= 0
+        assert val("kgct_hbm_bytes_in_use ") >= 0
+        # jit-cache entry count: the module's traffic compiled something.
+        assert val("kgct_jit_compiles_total ") > 0
+        # Per-phase mean step time, promoted from the tracer's breakdown.
+        assert "# TYPE kgct_step_phase_mean_seconds gauge" in text
+        assert val('kgct_step_phase_mean_seconds{phase="device_dispatch"}'
+                   ) > 0
+        # Rolling SLO layer: attainment in [0, 1], budget > 0, goodput >= 0.
+        att = val("kgct_slo_ttft_attainment_ratio ")
+        assert 0.0 <= att <= 1.0
+        assert val("kgct_slo_ttft_budget_ms ") > 0
+        assert val("kgct_slo_goodput_tokens_per_sec ") >= 0
+
+    def test_flightrecorder_endpoint(self, api_client):
+        loop, client = api_client
+
+        async def go():
+            r = await client.get("/debug/flightrecorder")
+            assert r.status == 200
+            return await r.json()
+        doc = loop.run_until_complete(go())
+        assert doc["enabled"] is True
+        kinds = {e["kind"] for e in doc["events"]}
+        # Mirrored lifecycle events from the module's traffic plus at
+        # least one periodic state snapshot.
+        assert "arrival" in kinds and "snapshot" in kinds
+        snap = next(e for e in doc["events"] if e["kind"] == "snapshot")
+        assert {"waiting", "running", "kv_pages_free"} <= set(snap)
+
+
+class TestRequestIdPropagation:
+    """The x-kgct-request-id contract on the replica side: an inbound id
+    (the router's mint) becomes the ENGINE request id — shared with the
+    lifecycle trace — and every response echoes an id, success or error."""
+
+    def test_inbound_id_adopted_and_traced(self, api_client):
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            REQUEST_ID_HEADER)
+        loop, client = api_client
+        rid = "req-test-correlate-1"
+
+        async def go():
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": "trace my id", "max_tokens": 4,
+                      "temperature": 0.0},
+                headers={REQUEST_ID_HEADER: rid})
+            assert r.status == 200
+            assert r.headers[REQUEST_ID_HEADER] == rid
+            data = await r.json()
+            assert data["id"] == rid              # engine adopted it
+            rt = await client.get("/debug/trace")
+            return await rt.json()
+        doc = loop.run_until_complete(go())
+        spans = [e for e in doc["traceEvents"]
+                 if e.get("cat") == "request" and e.get("id") == rid]
+        assert {e["ph"] for e in spans} >= {"b", "e"}, \
+            "engine lifecycle trace does not carry the inbound id"
+
+    def test_minted_id_on_success_and_errors(self, api_client):
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            REQUEST_ID_HEADER)
+        loop, client = api_client
+
+        async def go():
+            # No inbound header: a cmpl- id is minted and echoed.
+            r = await client.post("/v1/completions", json={
+                "prompt": "mint me", "max_tokens": 2, "temperature": 0.0})
+            assert r.headers[REQUEST_ID_HEADER].startswith("cmpl-")
+            assert (await r.json())["id"] == r.headers[REQUEST_ID_HEADER]
+            # Error responses carry the id too (a 400 in a client log must
+            # join the server's records).
+            r400 = await client.post("/v1/completions",
+                                     json={"max_tokens": 2})
+            assert r400.status == 400
+            assert REQUEST_ID_HEADER in r400.headers
+            # An invalid inbound id (spaces) is ignored, not echoed.
+            rbad = await client.post(
+                "/v1/completions",
+                json={"prompt": "x", "max_tokens": 2, "temperature": 0.0},
+                headers={REQUEST_ID_HEADER: "bad id with spaces"})
+            assert rbad.headers[REQUEST_ID_HEADER] != "bad id with spaces"
+            # Streaming: the header rides the SSE response's headers.
+            rs = await client.post("/v1/completions", json={
+                "prompt": "s", "max_tokens": 2, "temperature": 0.0,
+                "stream": True}, headers={REQUEST_ID_HEADER: "req-sse-7"})
+            assert rs.headers[REQUEST_ID_HEADER] == "req-sse-7"
+            await rs.read()
+        loop.run_until_complete(go())
+
+    def test_tracing_and_recorder_off_byte_identical(self, api_client):
+        """The acceptance pin: tracer+recorder only OBSERVE — toggling both
+        off must not perturb engine outputs (greedy, same warm engine)."""
+        loop, client = api_client
+        obs = _SERVER["api"].engine.engine.obs
+        body = {"prompt": "identical under observation", "max_tokens": 6,
+                "temperature": 0.0}
+
+        async def one():
+            r = await client.post("/v1/completions", json=body)
+            assert r.status == 200
+            return (await r.json())["choices"][0]["text"]
+        text_on = loop.run_until_complete(one())
+        obs.tracer.enabled = False
+        obs.flight.enabled = False
+        try:
+            text_off = loop.run_until_complete(one())
+        finally:
+            obs.tracer.enabled = True
+            obs.flight.enabled = True
+        assert text_on == text_off
+
+
 class TestRouter:
     def test_routes_and_failover(self, api_client):
         loop, client = api_client
