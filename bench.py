@@ -34,6 +34,7 @@ from __future__ import annotations
 import argparse
 import gc
 import json
+import math
 import os
 import sys
 import time
@@ -139,6 +140,20 @@ ROUTER_REPLICAS = int(os.environ.get("KGCT_BENCH_ROUTER_REPLICAS", 2))
 ROUTER_SESSIONS = int(os.environ.get("KGCT_BENCH_ROUTER_SESSIONS",
                                      ROUTER_REPLICAS + 1))
 ROUTER_ROUNDS = int(os.environ.get("KGCT_BENCH_ROUTER_ROUNDS", 3))
+# Disaggregation phase (serving/handoff.py + router prefill pool): a MIXED
+# long-prefill/long-decode workload A/B'd through the real serving stack —
+# 1 prefill + 1 decode replica (role-split, KV-page handoff) vs 2 colocated
+# replicas, all identically seeded. Mixed batching is OFF in both arms so
+# the colocated arm exhibits the full prefill/decode interference
+# disaggregation removes (the DistServe regime; mixed batching only BOUNDS
+# it). Sustained decode TPOT p95 and TTFT p50 come from ONE router scrape
+# per arm (the relabeled per-replica histograms). Always debug-tiny
+# engines, like the router phase. KGCT_BENCH_DISAGG=0 skips.
+DISAGG_BENCH = os.environ.get("KGCT_BENCH_DISAGG", "1") != "0"
+DISAGG_DECODE_SESSIONS = int(os.environ.get("KGCT_BENCH_DISAGG_SESSIONS", 3))
+DISAGG_DECODE_ROUNDS = int(os.environ.get("KGCT_BENCH_DISAGG_ROUNDS", 2))
+DISAGG_PREFILLS = int(os.environ.get("KGCT_BENCH_DISAGG_PREFILLS", 6))
+DISAGG_MAX_NEW = int(os.environ.get("KGCT_BENCH_DISAGG_MAX_NEW", 16))
 
 # The stdout contract bench.py guarantees (also the --help epilog, and what
 # tests/test_bench_contract.py pins): everything before the last line is
@@ -1039,6 +1054,277 @@ def _measure_router() -> dict:
     return out
 
 
+def _hist_buckets(text: str, family: str, replicas=None) -> dict:
+    """Cumulative bucket counts {le: count} for ``family`` summed over the
+    router-relabeled per-replica series (all label sets, e.g. the TTFT
+    histogram's outcome children), optionally restricted to ``replicas``
+    (URLs)."""
+    buckets: dict = {}
+    prefix = family + "_bucket{"
+    for line in text.splitlines():
+        if not line.startswith(prefix):
+            continue
+        labels, _, value = line[len(prefix):].partition("} ")
+        kv = dict(p.split("=", 1) for p in labels.split(",") if "=" in p)
+        le = kv.get('le', '"+Inf"').strip('"')
+        if replicas is not None and kv.get("replica", "").strip('"') \
+                not in replicas:
+            continue
+        try:
+            buckets[le] = buckets.get(le, 0.0) + float(value)
+        except ValueError:
+            continue
+    return buckets
+
+
+def _hist_delta(before: str, after: str, family: str,
+                replicas=None) -> dict:
+    """Measured-window bucket deltas {le: after - before} for ``family``,
+    keeping buckets whose first sample landed inside the window (absent
+    from the before-scrape). One parse per scrape text."""
+    after_b = _hist_buckets(after, family, replicas)
+    delta = {le: after_b.get(le, 0.0) - n
+             for le, n in _hist_buckets(before, family, replicas).items()}
+    for le, n in after_b.items():
+        delta.setdefault(le, n)
+    return delta
+
+
+def _bucket_quantile(delta: dict, q: float):
+    """Quantile (seconds) from cumulative-bucket DELTAS by linear
+    interpolation inside the crossing bucket; None on an empty window."""
+    def le_key(le):
+        return math.inf if le == "+Inf" else float(le)
+    items = sorted(delta.items(), key=lambda kv: le_key(kv[0]))
+    total = items[-1][1] if items else 0.0
+    if total <= 0:
+        return None
+    target = q * total
+    prev_le, prev_n = 0.0, 0.0
+    for le, n in items:
+        if n >= target:
+            hi = le_key(le)
+            if hi is math.inf:
+                return prev_le
+            frac = ((target - prev_n) / (n - prev_n)) if n > prev_n else 1.0
+            return prev_le + frac * (hi - prev_le)
+        prev_le, prev_n = le_key(le), n
+    return prev_le
+
+
+def _measure_disagg() -> dict:
+    """KGCT_BENCH_DISAGG phase: disaggregated prefill/decode A/B through
+    the real serving stack on a MIXED workload —
+
+    - arm "colocated": 2 role="both" replicas behind the router; every
+      replica interleaves long prefills with its decode steps, so decode
+      inter-token latency absorbs the prefill stalls (mixed batching is
+      OFF in both arms to expose the full interference that DistServe-
+      style disaggregation removes rather than bounds);
+    - arm "disagg": 1 role="prefill" + 1 role="decode" replica; the router
+      routes completions to the decode pool with an x-kgct-prefill-url
+      header, the decode replica pulls the prefilled KV (one contiguous
+      buffer) and resumes decode directly — its device steps are decode-
+      only, so TPOT stays flat while prefills land elsewhere.
+
+    Workload: DISAGG_DECODE_SESSIONS decode-heavy sessions (short prompt,
+    DISAGG_MAX_NEW tokens) run concurrently with DISAGG_PREFILLS long-
+    prompt/1-token prefill-heavy requests. Sustained decode TPOT p95 and
+    TTFT p50 are read from ONE router scrape per arm (delta of the
+    relabeled per-replica histograms over the measured window; the
+    prefill-heavy requests emit one token and thus never enter the TPOT
+    histogram — the p95 is pure decode-session TPOT). Headline:
+    ``disagg_tpot_over_colocated`` = disagg TPOT p95 / colocated's."""
+    import asyncio
+
+    import aiohttp
+    from aiohttp import web as aioweb
+
+    from kubernetes_gpu_cluster_tpu.serving.api_server import build_server
+    from kubernetes_gpu_cluster_tpu.serving.router import Router
+
+    on_tpu = jax.default_backend() == "tpu"
+    page = PAGE if PAGE is not None else (128 if on_tpu else 16)
+    short_len = 2 * page
+    long_len = 8 * page
+    vocab_cap = 200
+    ladder = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+    top = next((b for b in ladder if b >= long_len), long_len)
+    buckets = tuple(b for b in ladder if b < long_len) + (top,)
+    pages_per_seq = cdiv(long_len + DISAGG_MAX_NEW + 4, page) + 1
+
+    def engine_config():
+        return EngineConfig(
+            model=get_model_config("debug-tiny"),
+            cache=CacheConfig(
+                page_size=page,
+                num_pages=4 * (DISAGG_DECODE_SESSIONS + DISAGG_PREFILLS)
+                * pages_per_seq + 1),
+            scheduler=SchedulerConfig(
+                max_num_seqs=4, max_prefill_tokens=top,
+                decode_buckets=(1, 2, 4), prefill_buckets=buckets,
+                decode_window=4, mixed_batch_enabled=False))
+
+    def prompt_of(seed: int, length: int) -> list:
+        return np.random.default_rng(seed).integers(
+            1, vocab_cap, length).tolist()
+
+    async def run_arm(disagg: bool) -> dict:
+        runners = []
+
+        async def serve(role):
+            srv = build_server(engine_config(), None, "debug-tiny",
+                               role=role)
+            runner = aioweb.AppRunner(srv.build_app())
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runners.append(runner)
+            return f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+        if disagg:
+            prefill_urls = [await serve("prefill")]
+            decode_urls = [await serve("decode")]
+        else:
+            prefill_urls = None
+            decode_urls = [await serve("both"), await serve("both")]
+        router = Router(decode_urls, health_interval_s=9999,
+                        prefill_urls=prefill_urls)
+        rrunner = aioweb.AppRunner(router.build_app())
+        await rrunner.setup()
+        rsite = aioweb.TCPSite(rrunner, "127.0.0.1", 0)
+        await rsite.start()
+        router_url = f"http://127.0.0.1:{rrunner.addresses[0][1]}"
+
+        out: dict = {"arm": "disagg" if disagg else "colocated",
+                     "decode_replicas": decode_urls,
+                     "prefill_replicas": prefill_urls or []}
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async def complete(prompt, max_tokens):
+                    async with sess.post(
+                            f"{router_url}/v1/completions",
+                            json={"prompt": prompt,
+                                  "max_tokens": max_tokens,
+                                  "temperature": 0.0}) as resp:
+                        assert resp.status == 200, await resp.text()
+                        await resp.read()
+
+                async def scrape_router() -> str:
+                    async with sess.get(f"{router_url}/metrics") as resp:
+                        return await resp.text()
+
+                async def complete_at(base, prompt, max_tokens):
+                    async with sess.post(
+                            f"{base}/v1/completions",
+                            json={"prompt": prompt,
+                                  "max_tokens": max_tokens,
+                                  "temperature": 0.0}) as resp:
+                        assert resp.status == 200, await resp.text()
+                        await resp.read()
+
+                # Warmup, same work in both arms:
+                #  1. DIRECT per-replica long+short — every pod compiles
+                #     both prompt-length prefill buckets and the decode
+                #     window independent of the router's tie-break
+                #     rotation (routed warmup would send every long to one
+                #     colocated pod and every short to the other, leaving
+                #     each a bucket family to JIT inside the measured
+                #     window and biasing the A/B);
+                #  2. one long+short THROUGH the router — compiles the
+                #     disagg handoff gather/scatter pair on both sides of
+                #     the seam (plain extra traffic in the colocated arm);
+                #  3. a concurrent burst of short sessions — compiles the
+                #     larger decode batch buckets at the same concurrency
+                #     the measured window drives (the disagg decode pod
+                #     takes ALL sessions; a colocated pod roughly half).
+                for i, u in enumerate(decode_urls + (prefill_urls or [])):
+                    await complete_at(u, prompt_of(9_000 + i, long_len), 1)
+                    await complete_at(u, prompt_of(9_100 + i, short_len),
+                                      DISAGG_MAX_NEW)
+                await complete(prompt_of(9_200, long_len), 1)
+                await complete(prompt_of(9_300, short_len), DISAGG_MAX_NEW)
+                await asyncio.gather(
+                    *(complete(prompt_of(9_400 + s, short_len),
+                               DISAGG_MAX_NEW)
+                      for s in range(DISAGG_DECODE_SESSIONS)))
+                before = await scrape_router()
+
+                t0 = time.perf_counter()
+
+                async def decode_session(s: int):
+                    for r in range(DISAGG_DECODE_ROUNDS):
+                        await complete(
+                            prompt_of(1_000 * s + r, short_len),
+                            DISAGG_MAX_NEW)
+
+                async def prefill_storm():
+                    for i in range(DISAGG_PREFILLS):
+                        await complete(prompt_of(5_000 + i, long_len), 1)
+
+                await asyncio.gather(
+                    *(decode_session(s)
+                      for s in range(DISAGG_DECODE_SESSIONS)),
+                    prefill_storm())
+                wall = time.perf_counter() - t0
+                after = await scrape_router()
+
+            decode_set = {u for u in decode_urls}
+            tpot_d = _hist_delta(before, after, "kgct_tpot_seconds",
+                                 decode_set)
+            # TTFT from the DECODE pool only, like TPOT: in the disagg arm
+            # a handoff request samples TTFT on BOTH pools — partial
+            # (arrival-at-prefill -> first token) on the prefill replica,
+            # end-to-end (pull + remote prefill + import) on the decode
+            # replica — and only the latter compares with the colocated
+            # arm's full TTFT.
+            ttft_d = _hist_delta(before, after, "kgct_ttft_seconds",
+                                 decode_set)
+            tpot_p95 = _bucket_quantile(tpot_d, 0.95)
+            ttft_p50 = _bucket_quantile(ttft_d, 0.50)
+            out.update({
+                "wall_s": round(wall, 3),
+                "decode_tpot_p95_ms": (round(tpot_p95 * 1e3, 2)
+                                       if tpot_p95 is not None else None),
+                "ttft_p50_ms": (round(ttft_p50 * 1e3, 2)
+                                if ttft_p50 is not None else None),
+            })
+            if disagg:
+                handoffs = 0.0
+                for line in after.splitlines():
+                    if line.startswith("kgct_disagg_handoffs_total{") \
+                            and 'side="import"' in line \
+                            and 'outcome="ok"' in line:
+                        handoffs += float(line.rpartition(" ")[2])
+                out["handoffs_ok"] = int(handoffs)
+        finally:
+            await rrunner.cleanup()
+            for runner in runners:
+                await runner.cleanup()
+        return out
+
+    out: dict = {
+        "decode_sessions": DISAGG_DECODE_SESSIONS,
+        "decode_rounds": DISAGG_DECODE_ROUNDS,
+        "prefill_requests": DISAGG_PREFILLS,
+        "max_new": DISAGG_MAX_NEW,
+        "long_prompt_tokens": long_len,
+        "short_prompt_tokens": short_len,
+    }
+    for label, disagg in (("colocated", False), ("disagg", True)):
+        out[label] = asyncio.run(run_arm(disagg))
+        gc.collect()
+    co, dis = out["colocated"], out["disagg"]
+    out["tpot_p95_ratio"] = (
+        round(dis["decode_tpot_p95_ms"] / co["decode_tpot_p95_ms"], 3)
+        if dis.get("decode_tpot_p95_ms") and co.get("decode_tpot_p95_ms")
+        else None)
+    out["ttft_p50_ratio"] = (
+        round(dis["ttft_p50_ms"] / co["ttft_p50_ms"], 3)
+        if dis.get("ttft_p50_ms") and co.get("ttft_p50_ms") else None)
+    return out
+
+
 # --------------------------------------------------------------------------
 # Per-config driver
 # --------------------------------------------------------------------------
@@ -1262,6 +1548,12 @@ def assemble_output(results: list[dict], backend: str) -> dict:
         # A/B block in configs[-1].router_affinity).
         "router_affinity_warm_over_li_ttft": (
             primary.get("router_affinity", {}).get("warm_ttft_ratio")),
+        # Disaggregation phase headline: sustained decode TPOT p95 through
+        # the role-split prefill/decode topology as a fraction of the
+        # colocated topology's, from one router scrape per arm (full A/B
+        # block in configs[-1].disagg).
+        "disagg_tpot_over_colocated": (
+            primary.get("disagg", {}).get("tpot_p95_ratio")),
         # SLO headline: fraction of the overload phase's admitted requests
         # whose TTFT met the admission budget — the attainment read
         # BENCH_r06 captures alongside raw TTFT (full block in
@@ -1330,6 +1622,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
             "over in-process replicas, least-inflight vs prefix-affinity "
             "A/B, default on; 0=skip), KGCT_BENCH_ROUTER_REPLICAS, "
             "KGCT_BENCH_ROUTER_SESSIONS, KGCT_BENCH_ROUTER_ROUNDS, "
+            "KGCT_BENCH_DISAGG (1=disaggregated prefill/decode phase: "
+            "role-split 1 prefill + 1 decode replica with KV-page handoff "
+            "vs 2 colocated replicas on a mixed long-prefill/long-decode "
+            "workload, sustained decode TPOT p95 + TTFT from one router "
+            "scrape per arm, default on; 0=skip), "
+            "KGCT_BENCH_DISAGG_SESSIONS, KGCT_BENCH_DISAGG_ROUNDS, "
+            "KGCT_BENCH_DISAGG_PREFILLS, KGCT_BENCH_DISAGG_MAX_NEW, "
             "KGCT_BENCH_PROMPT, KGCT_BENCH_PAGE, "
             "KGCT_CHIP_HBM_GBPS, KGCT_CHIP_TFLOPS_BF16. KGCT_BENCH_QUANT "
             "accepts int8 or int4 (the W4A16 dequant-fused path)."))
@@ -1343,6 +1642,7 @@ _DROPPABLE_HEADLINE = ("ttft_decomposition", "baseline_bar", "mixed_batch",
                        "prefix_warm_over_cold_ttft",
                        "swap_resume_over_recompute_ttft", "preemptions",
                        "router_affinity_warm_over_li_ttft",
+                       "disagg_tpot_over_colocated",
                        "slo_ttft_attainment_ratio",
                        "decode_window", "prefill_budget", "vs_baseline")
 
@@ -1471,6 +1771,11 @@ def main() -> None:
         # Fleet-routing phase: in-process multi-replica A/B through the
         # real router (always debug-tiny engines; see _measure_router).
         results[-1]["router_affinity"] = _measure_router()
+    if DISAGG_BENCH:
+        # Disaggregation phase: role-split prefill/decode pools with KV
+        # handoff vs colocated replicas (always debug-tiny engines; see
+        # _measure_disagg).
+        results[-1]["disagg"] = _measure_disagg()
     emit_result(assemble_output(results, backend))
 
 

@@ -17,6 +17,7 @@ from .quant_surface import QuantSurfaceRule
 from .router_pick import RouterPickPathRule
 from .swap_order import SwapOrderRule
 from .trace_emit import TraceEmitHygieneRule
+from .kv_boundary import KVBoundaryRule
 
 ALL_RULES = [
     TraceSafetyRule(),
@@ -31,6 +32,7 @@ ALL_RULES = [
     SwapOrderRule(),
     RouterPickPathRule(),
     TraceEmitHygieneRule(),
+    KVBoundaryRule(),
 ]
 
 

@@ -1,0 +1,81 @@
+"""KGCT013 kv-export-boundary: KV pages cross the process boundary only
+through the sanctioned export/import seam.
+
+Disaggregated prefill/decode serving ships KV pages between replicas, and
+the two-tier cache ships them between device and host. Every one of those
+transfers must flow through ``engine/kv_cache.py``'s gather/scatter
+primitives (``KVSwapper`` / ``KVPageIO``): they are the only code that
+honors the ordering contracts (fetch completes before the pages can be
+freed — KGCT010; donated pool rebound before the next consumer — KGCT004)
+and the pow-2 compile-family discipline. A raw ``np.asarray`` /
+``jax.device_get`` of the KV pool anywhere else is an unsanctioned device
+fetch: it silently forks a second transfer path with none of those
+guarantees — a host sync on an arbitrary thread, racing the donated pool,
+invisible to the compile guard.
+
+Scope: the whole package except ``engine/kv_cache.py`` itself (the seam's
+home). The heuristic keys on the receiver expression: a device-fetch call
+whose argument's attribute chain contains a KV-pool name segment
+(``kv_cache`` / ``kv`` / ``kv_pool`` / ``host_pool``) fires.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, LintModule, Rule
+
+_EXEMPT = "engine/kv_cache.py"
+# Device-fetch spellings: numpy materialization and explicit device_get.
+_FETCH_DOTTED = frozenset({"np.asarray", "numpy.asarray", "np.array",
+                           "numpy.array", "jax.device_get"})
+_KV_SEGMENTS = frozenset({"kv_cache", "kv", "kv_pool", "host_pool"})
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            break
+        else:
+            break
+    return ".".join(reversed(parts))
+
+
+class KVBoundaryRule(Rule):
+    code = "KGCT013"
+    name = "kv-export-boundary"
+    description = ("KV pool device-fetched outside engine/kv_cache.py's "
+                   "sanctioned gather (the export/import seam of "
+                   "disaggregated serving and the two-tier cache)")
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        relpath = mod.relpath.replace("\\", "/")
+        if relpath.endswith(_EXEMPT):
+            return
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            fn = _dotted(node.func)
+            if fn not in _FETCH_DOTTED and \
+                    not fn.endswith((".asarray", ".device_get")):
+                continue
+            src = _dotted(node.args[0])
+            segments = set(src.split(".")) if src else set()
+            if segments & _KV_SEGMENTS:
+                yield self.finding(
+                    mod, node,
+                    f"device fetch of KV pool contents ({fn}({src}...)) "
+                    "outside engine/kv_cache.py — KV pages may only cross "
+                    "the process/host boundary through the sanctioned "
+                    "KVSwapper/KVPageIO gather, which owns the "
+                    "fetch-before-free ordering and the bounded compile "
+                    "family (use LLMEngine.export_held/import_request or "
+                    "the swapper)")

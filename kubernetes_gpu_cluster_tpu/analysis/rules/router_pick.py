@@ -10,9 +10,9 @@ bypass the ring (scattering sessions off their warm replica), skip the
 load bound, and desynchronize the tie-break sequence two routers must
 share to replay identically. Likewise ``Replica.inflight`` is the load
 signal both policies balance on: the ONLY sanctioned mutations are the
-``+= 1 / -= 1`` accounting pair around a proxied request in ``proxy`` (and
-field initialization in ``__init__``) — a stray mutation anywhere else
-skews every subsequent pick on every policy.
+``+= 1 / -= 1`` accounting pairs around a proxied request in
+``proxy``/``_forward`` (and field initialization in ``__init__``) — a
+stray mutation anywhere else skews every subsequent pick on every policy.
 
 Fires on, in ``serving/`` modules:
 
@@ -22,7 +22,7 @@ Fires on, in ``serving/`` modules:
   the seam (READING replicas/inflight for health or metrics rendering
   stays silent: iteration is not selection);
 - an assignment/augmented assignment to ``<x>.inflight`` outside
-  ``proxy``/``__init__``.
+  ``proxy``/``_forward``/``__init__``.
 
 Scope: ``serving/`` (the router and anything embedding it). Other modules
 are free to use min/sorted however they like.
@@ -39,7 +39,10 @@ from ..core import Finding, LintModule, Rule
 _SCOPE = re.compile(r"(^|/)serving/")
 # Functions sanctioned to SELECT a replica / to mutate inflight.
 _PICK_FNS = frozenset({"_pick"})
-_INFLIGHT_MUTATION_FNS = frozenset({"proxy", "__init__"})
+# proxy holds the prefill-pool pull slot; _forward (its failover loop,
+# split out so that slot's try/finally brackets it) holds the main-pool
+# pair. Both are the sanctioned accounting sites.
+_INFLIGHT_MUTATION_FNS = frozenset({"proxy", "_forward", "__init__"})
 _SELECTORS = frozenset({"min", "max", "sorted"})
 _RANDOM_PICKS = frozenset({"choice", "randrange", "randint", "sample",
                            "shuffle"})
@@ -85,8 +88,8 @@ class RouterPickPathRule(Rule):
                     yield self.finding(
                         mod, node,
                         f"Replica.inflight mutated in {fn.name!r} — the "
-                        "only sanctioned mutations are the proxy's "
-                        "+=1/-=1 accounting pair (and __init__); a stray "
+                        "only sanctioned mutations are the proxy/_forward "
+                        "+=1/-=1 accounting pairs (and __init__); a stray "
                         "write skews every subsequent load-balanced pick")
 
     @staticmethod

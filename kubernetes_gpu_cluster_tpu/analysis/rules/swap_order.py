@@ -26,7 +26,7 @@ from typing import Iterator
 from ..core import Finding, LintModule, Rule
 
 _SCOPE = re.compile(r"(^|/)engine/")
-_GATHERS = frozenset({"swap_out", "spill_page"})
+_GATHERS = frozenset({"swap_out", "spill_page", "export_pages"})
 # Device-page releases: the scheduler's _release helper, and .free() on an
 # allocator-ish receiver (self.allocator.free / allocator.free). Host-pool
 # frees (swapper.free_host / host.free) are NOT releases — the host copy

@@ -81,10 +81,21 @@ def _scrape_annotations(port: int) -> dict:
     }
 
 
-def _engine_args(spec: dict) -> list[str]:
+def _engine_args(spec: dict, role: Optional[str] = None) -> list[str]:
     cfg = spec.get("vllmConfig") or {}
     args = ["--model", str(spec["modelURL"]),
             "--port", str(ENGINE_PORT)]
+    if role is not None:
+        # Disaggregated prefill/decode: phase-dedicated replica pools
+        # (prefillReplicas/decodeReplicas). "both" is the engine default
+        # and renders no flag — byte-identical manifests for
+        # non-disaggregated specs.
+        args += ["--role", role]
+        if role == "decode":
+            # KV-pull allowlist: the decode pod only fetches handoffs from
+            # its spec's prefill pods — a client reaching the pod directly
+            # (per-pod DNS) cannot point the pull elsewhere (SSRF guard).
+            args += ["--prefill-pool", ",".join(_prefill_urls(spec))]
     tp = cfg.get("tensorParallelSize")
     pp = cfg.get("pipelineParallelSize")
     if tp is None and spec.get("requestGPU", 1) > 1:
@@ -138,7 +149,8 @@ def _engine_args(spec: dict) -> list[str]:
     return args
 
 
-def _pod_spec(spec: dict, engine: dict, multihost: bool) -> dict:
+def _pod_spec(spec: dict, engine: dict, multihost: bool,
+              role: Optional[str] = None) -> dict:
     name = spec["name"]
     tpus = int(spec.get("requestGPU", 0) or 0)
     resources: dict[str, Any] = {"requests": {}, "limits": {}}
@@ -179,7 +191,8 @@ def _pod_spec(spec: dict, engine: dict, multihost: bool) -> dict:
         "imagePullPolicy": spec.get("imagePullPolicy", "IfNotPresent"),
         "command": ["python", "-m",
                     "kubernetes_gpu_cluster_tpu.serving.api_server"],
-        "args": _engine_args(spec) + (["--distributed"] if multihost else []),
+        "args": (_engine_args(spec, role=role)
+                 + (["--distributed"] if multihost else [])),
         "ports": [{"containerPort": ENGINE_PORT, "name": "http"}],
         "resources": resources,
         "readinessProbe": {
@@ -217,6 +230,33 @@ def _pod_spec(spec: dict, engine: dict, multihost: bool) -> dict:
     return pod
 
 
+def _disagg(spec: dict) -> Optional[tuple[int, int]]:
+    """(prefillReplicas, decodeReplicas) when the modelSpec opts into
+    disaggregated prefill/decode serving; None otherwise. Both knobs must
+    be set together (a one-sided pool is a topology nobody can route),
+    and the mode does not compose with multihost — a pipeline group is
+    one step-lockstepped routing target that cannot split phases."""
+    name = spec.get("name", "?")
+    pf, dc = spec.get("prefillReplicas"), spec.get("decodeReplicas")
+    if pf is None and dc is None:
+        return None
+    if pf is None or dc is None:
+        raise ValueError(
+            f"modelSpec '{name}': prefillReplicas and decodeReplicas must "
+            "be set together (one-sided pools cannot be routed)")
+    pf, dc = int(pf), int(dc)
+    if pf < 1 or dc < 1:
+        raise ValueError(
+            f"modelSpec '{name}': prefillReplicas/decodeReplicas must "
+            f"both be >= 1 (got {pf}/{dc})")
+    if _is_multihost(spec):
+        raise ValueError(
+            f"modelSpec '{name}': disaggregated prefill/decode does not "
+            "compose with multihost/raySpec (a pipeline group steps in "
+            "SPMD lockstep and cannot split phases)")
+    return pf, dc
+
+
 def _is_multihost(spec: dict) -> bool:
     """One StatefulSet-of-ranks pod group (vs N independent replica pods).
     The ONE definition: the workload-kind choice in _render_model and the
@@ -227,22 +267,86 @@ def _is_multihost(spec: dict) -> bool:
     return bool(spec.get("raySpec")) or cfg.get("pipelineParallelSize", 1) > 1
 
 
+def _pod_urls(name: str, count: int) -> list[str]:
+    """Stable per-pod DNS names of a StatefulSet + headless Service."""
+    return [f"http://kgct-{name}-engine-{i}.kgct-{name}-engine-hl:"
+            f"{ENGINE_PORT}" for i in range(count)]
+
+
 def _replica_urls(spec: dict, affinity: bool) -> list[str]:
-    """The router's view of one modelSpec: either the model's Service (one
-    URL; kube-proxy balances across pods behind it) or — in prefix-affinity
-    mode, where kube-proxy's random pod choice would scatter a session's
-    requests and destroy the cache locality the ring exists to protect —
-    one stable per-pod DNS name per replica (StatefulSet + headless
-    Service), so the hash ring owns individual pods."""
+    """The router's view of one modelSpec's CLIENT-FACING pool: either the
+    model's Service (one URL; kube-proxy balances across pods behind it)
+    or — in prefix-affinity mode, where kube-proxy's random pod choice
+    would scatter a session's requests and destroy the cache locality the
+    ring exists to protect — one stable per-pod DNS name per replica
+    (StatefulSet + headless Service), so the hash ring owns individual
+    pods. Disaggregated specs always address pods directly: both pools'
+    rings must own individual replicas."""
     name = spec["name"]
+    disagg = _disagg(spec)
+    if disagg is not None:
+        return _pod_urls(f"{name}-decode", disagg[1])
     if not affinity or _is_multihost(spec):
         # Multihost keeps its rank-0 Service even under affinity: client
         # traffic must only reach rank 0 (it drives the global-mesh step),
         # so the group IS one routing target.
         return [f"http://kgct-{name}-engine-svc:{ENGINE_PORT}"]
-    return [f"http://kgct-{name}-engine-{i}.kgct-{name}-engine-hl:"
-            f"{ENGINE_PORT}"
-            for i in range(int(spec.get("replicaCount", 1)))]
+    return _pod_urls(name, int(spec.get("replicaCount", 1)))
+
+
+def _prefill_urls(spec: dict) -> list[str]:
+    """Per-pod URLs of the modelSpec's PREFILL pool (empty when the spec
+    is not disaggregated)."""
+    disagg = _disagg(spec)
+    if disagg is None:
+        return []
+    return _pod_urls(f"{spec['name']}-prefill", disagg[0])
+
+
+def _render_disagg_model(spec: dict, engine: dict,
+                         disagg: tuple[int, int]) -> dict[str, dict]:
+    """Disaggregated modelSpec -> role-split manifests: one StatefulSet +
+    headless Service per phase pool. Both pools are StatefulSets with
+    per-pod DNS regardless of routing policy — the prefill ring must own
+    individual pods (a kube-proxy VIP would re-scatter the prefix keys),
+    and the decode pool is addressed per-pod for session affinity the
+    same way prefix-affinity addresses colocated replicas."""
+    name = spec["name"]
+    out: dict[str, dict] = {}
+    for role, count in (("prefill", disagg[0]), ("decode", disagg[1])):
+        pool = f"{name}-{role}"
+        labels = _labels(pool, "serving-engine")
+        pod = {"metadata": {"labels": labels,
+                            "annotations": _scrape_annotations(ENGINE_PORT)},
+               "spec": _pod_spec(spec, engine, False, role=role)}
+        out[f"{name}-{role}-engine-statefulset.yaml"] = {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {"name": f"kgct-{pool}-engine", "labels": labels},
+            "spec": {
+                "serviceName": f"kgct-{pool}-engine-hl",
+                "replicas": count,
+                "podManagementPolicy": "Parallel",
+                "selector": {"matchLabels": labels},
+                "template": pod,
+            },
+        }
+        out[f"{name}-{role}-engine-headless-svc.yaml"] = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": f"kgct-{pool}-engine-hl",
+                         "labels": labels},
+            "spec": {
+                "clusterIP": "None",
+                # The router (and decode-side KV pulls) probe pods
+                # directly; per-pod DNS must resolve from the moment the
+                # pod exists.
+                "publishNotReadyAddresses": True,
+                "selector": labels,
+                "ports": [{"name": "http", "port": ENGINE_PORT}],
+            },
+        }
+    return out
 
 
 def _render_model(spec: dict, engine: dict,
@@ -250,6 +354,9 @@ def _render_model(spec: dict, engine: dict,
     """One modelSpec entry -> its manifests {filename: manifest}."""
     name = spec["name"]
     cfg = spec.get("vllmConfig") or {}
+    disagg = _disagg(spec)
+    if disagg is not None:
+        return _render_disagg_model(spec, engine, disagg)
     multihost = _is_multihost(spec)
     labels = _labels(name, "serving-engine")
     sel = {"matchLabels": labels}
@@ -357,11 +464,19 @@ def _render_model(spec: dict, engine: dict,
 
 
 def _render_router(replica_urls: list[str], router_spec: dict,
-                   routing: Optional[dict] = None) -> dict[str, dict]:
+                   routing: Optional[dict] = None,
+                   prefill_urls: Optional[list[str]] = None
+                   ) -> dict[str, dict]:
     labels = _labels("router", "router")
     replicas = ",".join(replica_urls)
     routing = routing or {}
     policy_args: list[str] = []
+    if prefill_urls:
+        # Disaggregated prefill/decode: the router owns the phase split —
+        # completions stream from --replicas (decode pool) while the
+        # forwarded x-kgct-prefill-url header names the prefix-affine
+        # member of this pool.
+        policy_args += ["--prefill-replicas", ",".join(prefill_urls)]
     if routing.get("policy"):
         policy_args += ["--routing-policy", str(routing["policy"])]
     if routing.get("affinityPrefixLen") is not None:
@@ -422,6 +537,108 @@ def _render_router(replica_urls: list[str], router_spec: dict,
             },
         },
     }
+
+
+def _quantity(x: float) -> str:
+    """k8s resource.Quantity spelling for a small decimal (HPA
+    AverageValue targets): milli-units keep sub-1.0 values exact."""
+    return f"{int(round(float(x) * 1000))}m"
+
+
+def _render_hpa(spec: dict, affinity: bool) -> dict[str, dict]:
+    """autoscaling.enabled -> one autoscaling/v2 HPA per modelSpec, driven
+    by the landed autoscaler signals (ROADMAP 4(b)): queue-wait pressure
+    (``kgct_queue_wait_seconds`` p90 via a prometheus-adapter rule) and
+    the shed rate (``rate(kgct_requests_shed_total[1m])``). The SLO gauge
+    ``kgct_slo_ttft_attainment_ratio`` is deliberately NOT a scale metric
+    — it FALLS under load, the inverse of HPA's scale-up direction — so it
+    rides along as the alerting guardrail, documented in the annotations.
+
+    Deployment topology only: prefix-affinity / disaggregated /multihost
+    specs route a STATIC per-pod replica list rendered into the router
+    args, which an HPA would silently outgrow (scale-up pods no traffic,
+    scale-down pods 502s). Those topologies fail the RENDER with
+    guidance rather than shipping an autoscaler that fights the ring."""
+    name = spec["name"]
+    auto = spec.get("autoscaling") or {}
+    if not auto.get("enabled"):
+        return {}
+    if _is_multihost(spec):
+        raise ValueError(
+            f"modelSpec '{name}': autoscaling.enabled does not compose "
+            "with multihost/raySpec — the StatefulSet's replica count IS "
+            "the pipeline world size, not a capacity knob")
+    if affinity or _disagg(spec) is not None:
+        raise ValueError(
+            f"modelSpec '{name}': autoscaling.enabled requires the "
+            "Deployment topology (least-inflight, service-balanced). "
+            "prefix-affinity and disaggregated pools render a STATIC "
+            "per-pod replica list into the router args; an HPA would "
+            "scale pods the ring never owns. Scale those topologies by "
+            "re-rendering with a new replicaCount (only ~K/N keys remap "
+            "— watch kgct_router_ring_remaps_total)")
+    minr = int(auto.get("minReplicas", 1))
+    maxr = int(auto.get("maxReplicas",
+                        max(2 * int(spec.get("replicaCount", 1)),
+                            minr + 1)))
+    if maxr < minr:
+        raise ValueError(f"modelSpec '{name}': autoscaling maxReplicas "
+                         f"{maxr} < minReplicas {minr}")
+    labels = _labels(name, "autoscaler")
+    return {f"{name}-engine-hpa.yaml": {
+        "apiVersion": "autoscaling/v2",
+        "kind": "HorizontalPodAutoscaler",
+        "metadata": {
+            "name": f"kgct-{name}-engine-hpa",
+            "labels": labels,
+            "annotations": {
+                # The prometheus-adapter rules an operator installs to
+                # feed these Pods metrics — rendered here so the HPA
+                # document carries its own wiring recipe.
+                "kgct.io/adapter-rule-queue-wait": (
+                    "kgct_queue_wait_seconds_p90 = histogram_quantile("
+                    "0.9, sum by (pod, le) "
+                    "(rate(kgct_queue_wait_seconds_bucket[2m])))"),
+                "kgct.io/adapter-rule-shed-rate": (
+                    "kgct_requests_shed_per_second = sum by (pod) "
+                    "(rate(kgct_requests_shed_total[1m]))"),
+                "kgct.io/slo-guardrail": (
+                    "alert on kgct_slo_ttft_attainment_ratio < 0.9 — it "
+                    "falls under load (inverse of HPA direction), so it "
+                    "guards the scaler instead of driving it"),
+            },
+        },
+        "spec": {
+            "scaleTargetRef": {"apiVersion": "apps/v1",
+                               "kind": "Deployment",
+                               "name": f"kgct-{name}-engine"},
+            "minReplicas": minr,
+            "maxReplicas": maxr,
+            "metrics": [
+                {"type": "Pods", "pods": {
+                    "metric": {"name": "kgct_queue_wait_seconds_p90"},
+                    "target": {"type": "AverageValue",
+                               "averageValue": _quantity(
+                                   auto.get("targetQueueWaitSeconds",
+                                            0.5))}}},
+                {"type": "Pods", "pods": {
+                    "metric": {"name": "kgct_requests_shed_per_second"},
+                    "target": {"type": "AverageValue",
+                               "averageValue": _quantity(
+                                   auto.get("targetShedPerSecond",
+                                            0.1))}}},
+            ],
+            # Shed-rate spikes scale up immediately; scale-down waits out
+            # a stabilization window so a lull does not flap the fleet
+            # (every scale event drains pods through the SIGTERM
+            # drain/admission machinery).
+            "behavior": {
+                "scaleUp": {"stabilizationWindowSeconds": 0},
+                "scaleDown": {"stabilizationWindowSeconds": int(
+                    auto.get("scaleDownStabilizationSeconds", 300))},
+            },
+        },
+    }}
 
 
 # Architecture families the shared decoder graph serves (models/llama.py +
@@ -530,15 +747,31 @@ def render_values(values: dict) -> dict[str, dict]:
         "balanceFactor": knob("balanceFactor"),
     }
     affinity = routing["policy"] == "prefix-affinity"
+    disagg_names = [s.get("name", "?") for s in specs if _disagg(s)]
+    if disagg_names and len(specs) > 1:
+        # The stack has ONE router and thus ONE prefill ring, while each
+        # decode pod's --prefill-pool allowlist covers only its own spec's
+        # prefill pods: a mixed stack would deterministically route a
+        # fraction of handoffs to out-of-pool (or wrong-model) prefill
+        # pods, silently degrading them to local recompute.
+        raise ValueError(
+            f"modelSpec(s) {disagg_names} use disaggregated prefill/decode "
+            "in a multi-modelSpec stack — the router's single prefill ring "
+            "cannot split across specs; render each disaggregated "
+            "modelSpec as its own values file/stack")
     out: dict[str, dict] = {}
     replica_urls: list[str] = []
+    prefill_urls: list[str] = []
     for spec in specs:
         if not spec.get("name"):
             raise ValueError("modelSpec entry missing 'name'")
         _validate_model_url(spec)
         out.update(_render_model(spec, engine, affinity=affinity))
+        out.update(_render_hpa(spec, affinity))
         replica_urls.extend(_replica_urls(spec, affinity))
-    out.update(_render_router(replica_urls, router_spec, routing))
+        prefill_urls.extend(_prefill_urls(spec))
+    out.update(_render_router(replica_urls, router_spec, routing,
+                              prefill_urls=prefill_urls))
     return out
 
 

@@ -38,8 +38,8 @@ from ..ops.sampling import (apply_logit_bias, apply_penalties, build_counts,
                             token_logprobs)
 from ..resilience.faults import inject as _inject_fault
 from ..utils import cdiv, get_logger
-from .kv_cache import (KVCache, allocate_kv_cache, build_kv_swapper,
-                       derive_num_pages)
+from .kv_cache import (KVCache, KVPageIO, KVTransferPrograms,
+                       allocate_kv_cache, build_kv_swapper, derive_num_pages)
 from .sampling_params import LOGIT_BIAS_CAP, SamplingParams
 from .scheduler import ScheduledBatch, Scheduler
 from .sequence import FinishReason, Sequence, SequenceStatus
@@ -260,6 +260,11 @@ class LLMEngine:
         self.step_count = 0
         # Speculative decode-window chain state (see step()).
         self._inflight: Optional[dict] = None
+        # Set by import_request: a sequence joined ``running`` outside
+        # schedule(), so a chained decode window's batch no longer covers
+        # all running work — the chain must break at the next step or the
+        # import would starve until some chained sequence finishes.
+        self._batch_stale = False
         self._deferred_release: list[Sequence] = []
         self._last_step_info = None
         self._ttft_transfer_s: Optional[float] = None
@@ -284,11 +289,16 @@ class LLMEngine:
         # swap instead of recompute, and the prefix cache spills evicted
         # pages for a second-chance restore. None when off — every call
         # site degrades to today's single-tier behavior byte-identically.
+        # One gather/scatter pair serves BOTH transfer seams (host-tier
+        # swap and cross-replica handoff): a decode replica with
+        # swap_space_gb > 0 compiles one family, not two identical copies.
+        self._kv_programs = KVTransferPrograms(
+            jit_enabled=not config.enforce_eager, kv_sharding=kv_sharding)
         self.swapper = build_kv_swapper(
             config.model, config.cache, self.kv_cache,
             get_kv=lambda: self.kv_cache, set_kv=self._set_kv_cache,
             obs=self.obs, jit_enabled=not config.enforce_eager,
-            kv_sharding=kv_sharding)
+            kv_sharding=kv_sharding, programs=self._kv_programs)
         if self.swapper is not None:
             self.scheduler.attach_swapper(self.swapper)
             if self.scheduler.prefix_cache is not None:
@@ -297,6 +307,12 @@ class LLMEngine:
                 # The KV-slot shadow learns that a swapped-in slot is
                 # committed history (stale spec slots died with the swap).
                 self.swapper.on_restored = self._sanitizer.on_swap_restore
+        # Disaggregated prefill/decode: the KV export/import seam. Both
+        # jitted transfer programs compile lazily — engines that never hand
+        # KV between replicas never pay for them (kv_cache.KVPageIO).
+        self.kv_io = KVPageIO(
+            get_kv=lambda: self.kv_cache, set_kv=self._set_kv_cache,
+            programs=self._kv_programs)
         # Black-box flight recorder: periodic state snapshots (queue depths,
         # KV occupancy both tiers) ride Observability.on_step; the source is
         # O(1) attribute reads, never a device sync (KGCT012).
@@ -323,8 +339,8 @@ class LLMEngine:
         recompilation storm in progress."""
         fns = [self._prefill_fn, self._prefill_hist_fn, self._mixed_fn,
                self._decode_fn, self._decode_fn_greedy, self._spec_verify_fn]
-        if self.swapper is not None:
-            fns += [self.swapper._gather_fn, self.swapper._scatter_fn]
+        # The shared pair counts once: swapper and kv_io both run it.
+        fns += [self._kv_programs._gather_fn, self._kv_programs._scatter_fn]
         return sum(fn._cache_size() for fn in fns
                    if fn is not None and hasattr(fn, "_cache_size"))
 
@@ -904,7 +920,19 @@ class LLMEngine:
     # -- public API ---------------------------------------------------------
 
     def add_request(self, request_id: str, prompt_token_ids: list[int],
-                    params: Optional[SamplingParams] = None) -> None:
+                    params: Optional[SamplingParams] = None,
+                    hold_kv: bool = False,
+                    arrival_t0: Optional[float] = None) -> None:
+        """``hold_kv``: disaggregated-prefill mode — when the request
+        finishes (normally with max_tokens=1 on a prefill replica), its
+        committed KV pages are HELD for :meth:`export_held` instead of
+        released; the caller owns the export-or-discard.
+
+        ``arrival_t0``: backdated ``time.monotonic()`` arrival stamp — a
+        decode replica whose handoff pull failed admits the request only
+        AFTER the pull burned its wall time, and that wait is part of the
+        client-observed TTFT/queue-wait span the SLO gauges exist to
+        catch."""
         params = params or SamplingParams()
         if params.logit_bias:
             # Out-of-vocab ids would be silently dropped by the device
@@ -917,6 +945,9 @@ class LLMEngine:
                     f"vocab_size {V}")
         seq = Sequence(request_id, prompt_token_ids, params,
                        eos_token_id=self.eos_token_id)
+        seq.hold_kv = hold_kv
+        if arrival_t0 is not None:
+            seq.arrival_time = min(arrival_t0, seq.arrival_time)
         self.obs.on_arrival(seq)
         try:
             self.scheduler.add(seq)
@@ -949,12 +980,176 @@ class LLMEngine:
             # drifts from kgct_requests_total.
             self.stats.requests_finished += 1
             return True
+        if request_id in self.scheduler.held:
+            # A held prefill whose exporter died between finish and export
+            # (kv_handoff pull timeout/disconnect): the sequence already
+            # counted as finished — only the parked pages remain, and no
+            # other abort path scans ``held``, so without this they would
+            # leak until the pool drains.
+            self.discard_held(request_id)
+            return True
         return False
 
     def has_unfinished_requests(self) -> bool:
         # An in-flight window must be drained even if every sequence finished
         # (its deferred page releases happen at drain time).
         return self.scheduler.has_work() or self._inflight is not None
+
+    # -- disaggregated prefill/decode (KV handoff seam) ----------------------
+
+    def export_held(self, request_id: str) -> dict:
+        """Serialize a held finished prefill (``add_request(hold_kv=True)``)
+        into one contiguous host-buffer state dict: the sequence's committed
+        KV pages (positions [0, num_tokens-1) — the last sampled token's KV
+        is written by the decode side's first step, exactly like swap
+        restore) plus the generation state a decode replica needs to resume
+        byte-identically. Pages are released here; raises KeyError when
+        nothing is held under ``request_id`` (capacity-terminated or
+        already exported) — the caller degrades to local recompute."""
+        seq = self.scheduler.held.pop(request_id, None)
+        if seq is None:
+            raise KeyError(f"no held KV for request {request_id!r}")
+        ps = self.config.cache.page_size
+        n = cdiv(seq.num_tokens - 1, ps)
+        k_np, v_np = self.kv_io.export_pages(seq.pages[:n])
+        # Gather fetched above; only now may the pages return to the pool
+        # (KGCT010 ordering).
+        self.scheduler.allocator.free(seq.pages)
+        seq.pages = []
+        return {
+            "model": self.model_config.name,
+            "page_size": ps,
+            "dtype": str(self.kv_cache.k.dtype),
+            "prompt_token_ids": list(seq.prompt_token_ids),
+            "output_token_ids": list(seq.output_token_ids),
+            "output_logprobs": list(seq.output_logprobs),
+            "output_top_logprobs": [
+                [[int(t), float(lp)] for t, lp in top]
+                for top in seq.output_top_logprobs],
+            "k": k_np, "v": v_np,
+        }
+
+    def discard_held(self, request_id: str) -> None:
+        """Release a held prefill whose export never happened (client died
+        between finish and export). Idempotent."""
+        seq = self.scheduler.held.pop(request_id, None)
+        if seq is not None:
+            self.scheduler._release(seq)
+
+    def import_request(self, request_id: str, prompt_token_ids: list[int],
+                       params: SamplingParams, state: dict
+                       ) -> list[RequestOutput]:
+        """Admit a prefill-replica export as COMMITTED history: allocate
+        pages, scatter the transferred KV in (kv_cache.KVPageIO — the
+        swap-in path, no prefill replay), and join ``running`` directly so
+        the next decode batch carries the sequence as if it prefilled here.
+        Returns the RequestOutput carrying the already-generated token(s)
+        so the serving layer streams them to the client. Raises on any
+        mismatch or capacity shortfall — the caller falls back to local
+        recompute (``add_request``), which is byte-identical, just slower."""
+        # Serving-layer stamp of when the decode replica began the handoff
+        # (pull start): now - t0 is the replica-observed TTFT — remote
+        # prefill + transfer + import — the client-facing span.
+        ttft_t0 = state.pop("_ttft_t0", None)
+        ps = self.config.cache.page_size
+        if state.get("model") != self.model_config.name:
+            raise ValueError(f"handoff model {state.get('model')!r} != "
+                             f"{self.model_config.name!r}")
+        if state.get("page_size") != ps:
+            raise ValueError(f"handoff page_size {state.get('page_size')} "
+                             f"!= {ps}")
+        if list(state["prompt_token_ids"]) != list(prompt_token_ids):
+            raise ValueError("handoff prompt does not match the request")
+        # Convert EVERYTHING the post-allocation path consumes up front —
+        # malformed state must raise before any pages are allocated, or a
+        # hostile/buggy peer could leak device pages per rejected handoff.
+        try:
+            out_ids = [int(t) for t in state["output_token_ids"]]
+            lps = [float(x) for x in (state.get("output_logprobs") or [])]
+            tops = [[(int(t), float(p)) for t, p in row]
+                    for row in (state.get("output_top_logprobs") or [])]
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"malformed handoff output state: {e}") from e
+        if not out_ids:
+            raise ValueError("handoff carries no generated token")
+        k_np, v_np = state["k"], state["v"]
+        num_tokens = len(prompt_token_ids) + len(out_ids)
+        need = cdiv(num_tokens - 1, ps)
+        L, _, _, kd = self.kv_cache.k.shape
+        if tuple(k_np.shape) != (L, need, ps, kd) or k_np.shape != v_np.shape:
+            raise ValueError(f"handoff KV shape {tuple(k_np.shape)} != "
+                             f"{(L, need, ps, kd)}")
+        if str(k_np.dtype) != str(self.kv_cache.k.dtype):
+            raise ValueError(f"handoff KV dtype {k_np.dtype} != "
+                             f"{self.kv_cache.k.dtype}")
+        sched = self.scheduler
+        if len(sched.running) >= sched.max_num_seqs:
+            raise RuntimeError("no batch seat for imported sequence")
+        if not sched.allocator.can_allocate(need):
+            raise RuntimeError(
+                f"no KV pages for imported sequence (want {need}, "
+                f"free {sched.allocator.num_free})")
+        seq = Sequence(request_id, prompt_token_ids, params,
+                       eos_token_id=self.eos_token_id)
+        pages = sched.allocator.allocate(need)
+        try:
+            self.kv_io.import_pages(pages, k_np, v_np)
+        except Exception:
+            sched.allocator.free(pages)
+            raise
+        seq.pages = pages
+        seq.num_prefilled = seq.num_prompt_tokens
+        seq.prefix_checked = True
+        want_lps = params.logprobs
+        want_top = params.top_logprobs
+        for j, tok in enumerate(out_ids):
+            lp = lps[j] if want_lps and j < len(lps) else None
+            top = tops[j] if want_top and j < len(tops) else None
+            seq.append_token(tok, lp, top)
+        seq.status = SequenceStatus.RUNNING
+        sched.running.append(seq)
+        self.obs.on_arrival(seq)
+        self.obs.on_scheduled(seq, 1)
+        if ttft_t0 is not None:
+            # step() never fires on_first_token for an imported sequence
+            # (append_token above already stamped first_token_time), so the
+            # TTFT sample — histogram + SLO attainment window + the goodput
+            # gate on_finish applies — lands here with the true span.
+            self.obs.on_handoff_first_token(
+                seq, max(time.monotonic() - ttft_t0, 0.0))
+        self.obs.tracer.emit("handoff", request_id, side="import",
+                             pages=need, tokens=len(out_ids))
+        if self._sanitizer is not None:
+            # The KV-slot shadow learns the imported slots are committed
+            # history — same contract as a swap restore.
+            self._sanitizer.on_swap_restore(seq)
+        reason = seq.check_stop(self.config.effective_max_len)
+        if reason is not None:
+            sched.finish(seq, reason)
+            self.stats.requests_finished += 1
+        else:
+            # A chained decode window's batch predates this sequence —
+            # break the chain at the next step so the import is not
+            # starved. A sequence that finished AT import left ``running``
+            # net-unchanged: the live window still covers every runner, so
+            # no break (a prefill-heavy max_tokens=1 storm would otherwise
+            # pay a schedule round-trip per import on the decode replica).
+            self._batch_stale = True
+        return [RequestOutput(
+            request_id=request_id,
+            prompt_token_ids=list(prompt_token_ids),
+            output_token_ids=list(seq.output_token_ids),
+            finished=seq.is_finished,
+            finish_reason=(seq.finish_reason.value
+                           if seq.finish_reason else None),
+            new_token_ids=out_ids,
+            new_logprobs=(list(lps) if want_lps else None),
+            output_logprobs=(list(seq.output_logprobs)
+                             if want_lps else None),
+            new_top_logprobs=(list(seq.output_top_logprobs)
+                              if want_top else None),
+            output_top_logprobs=(list(seq.output_top_logprobs)
+                                 if want_top else None))]
 
     def step(self) -> list[RequestOutput]:
         # Chaos site: KGCT_FAULT=step_stall:delay=N sleeps here, simulating a
@@ -1005,6 +1200,7 @@ class LLMEngine:
         if inflight is None:
             with ph("schedule"):
                 batch = self.scheduler.schedule()
+            self._batch_stale = False
             drained = self._drain_terminally_finished()
             if batch is None:
                 return drained
@@ -1090,6 +1286,7 @@ class LLMEngine:
         # after n-gram matches appear in the generated text (schedule()
         # only re-evaluates spec eligibility between chains).
         if (not self.scheduler.waiting and not inflight["zombies"]
+                and not self._batch_stale
                 and not self.scheduler.spec_enabled):
             successor = self._advance_window(inflight)
 
@@ -1496,6 +1693,13 @@ class LLMEngine:
 
     def _drain_deferred(self) -> None:
         for seq in self._deferred_release:
+            if (seq.hold_kv and seq.pages
+                    and seq.finish_reason != FinishReason.ABORT):
+                # Disaggregated prefill finishing inside a chained decode
+                # window (max_tokens > 1 holds): the export seam owns the
+                # release, exactly like the scheduler.finish hold path.
+                self.scheduler.held[seq.request_id] = seq
+                continue
             if seq.pages:
                 self.scheduler.allocator.free(seq.pages)
                 seq.pages = []

@@ -213,46 +213,26 @@ class HostKVPool:
         return self.k[:, idx], self.v[:, idx]
 
 
-class KVSwapper:
-    """Device<->host page movement for the two-tier KV cache.
+class KVTransferPrograms:
+    """The one jitted gather/scatter pair behind every KV transfer seam —
+    :class:`KVSwapper` (device<->host tier) and :class:`KVPageIO`
+    (cross-replica handoff) share a single instance, so a decode replica
+    with ``swap_space_gb > 0`` compiles ONE gather and ONE scatter family,
+    not two identical copies, and any future change to the transfer
+    discipline lands in one place.
 
-    One batched jitted GATHER collects a sequence's pages from the device
-    pool into a contiguous ``[L, n_pad, ps, kd]`` transfer buffer, and one
-    batched SCATTER (pool donated — XLA updates it in place, like every
-    step program) restores them. Page-count inputs are padded to powers of
-    two so each direction compiles at most ``log2(max pages/seq)`` variants
-    — inside the bounded bucket grid tests/test_compile_guard.py pins.
-
-    Ordering contracts (KGCT010 polices the static half):
-
-    - ``swap_out`` returns only after ``np.asarray`` fully fetched the
-      gather — the caller may free the device pages immediately after, and
-      the next step's dispatch may consume the donated pool.
-    - ``swap_in``/``restore_page`` scatter through ``get_kv``/``set_kv`` and
-      must only run when no dispatched program is in flight (the engine's
-      schedule-time paths satisfy this; the donated input is dead the moment
-      the call returns, exactly like a step program's pool).
-
-    Padding rows of both transfers are routed to ``SCRAP_PAGE``, which never
-    backs real tokens — a padded scatter write is harmless by construction.
+    One batched GATHER collects pages from the device pool into a
+    contiguous ``[L, n_pad, ps, kd]`` transfer buffer, and one batched
+    SCATTER (pool donated — XLA updates it in place, like every step
+    program) writes them back. Page-count inputs are padded to powers of
+    two with padding rows routed to ``SCRAP_PAGE`` (which never backs real
+    tokens — a padded write is harmless by construction), so each direction
+    compiles at most ``log2(max pages/seq)`` variants — inside the bounded
+    bucket grid tests/test_compile_guard.py pins. Both programs compile
+    lazily: engines that never transfer never pay.
     """
 
-    def __init__(self, host_pool: HostKVPool,
-                 get_kv: Callable[[], "KVCache"],
-                 set_kv: Callable[["KVCache"], None],
-                 obs=None, jit_enabled: bool = True, kv_sharding=None):
-        self.host = host_pool
-        self._get_kv = get_kv
-        self._set_kv = set_kv
-        self.obs = obs
-        # Optional host-tier reclaim hook (the prefix-spill store registers
-        # one): asked to drop LRU spilled entries when a swap-out needs room
-        # — live-session KV outranks re-computable spilled prefixes.
-        self.reclaim = None
-        # Optional restore notification (the KGCT_SANITIZE KV-slot shadow
-        # registers one): a swapped-in slot is committed history.
-        self.on_restored = None
-
+    def __init__(self, jit_enabled: bool = True, kv_sharding=None):
         def gather(k, v, idx):
             return k[:, idx], v[:, idx]
 
@@ -274,6 +254,71 @@ class KVSwapper:
         idx[:len(pages)] = pages
         return idx
 
+    def gather_pages(self, kv: "KVCache",
+                     pages: list[int]) -> tuple[np.ndarray, np.ndarray]:
+        """Gather ``pages`` into one contiguous host buffer pair ``(k, v)``
+        of shape ``[L, n, ps, kd]``. The fetch COMPLETES inside this call
+        (``np.asarray``): after return the device pages are free to be
+        released and reallocated (KGCT010)."""
+        n = len(pages)
+        k_g, v_g = self._gather_fn(kv.k, kv.v, self._padded_idx(pages))
+        return np.asarray(k_g)[:, :n], np.asarray(v_g)[:, :n]
+
+    def scatter_pages(self, kv: "KVCache", device_pages: list[int],
+                      k_np: np.ndarray, v_np: np.ndarray) -> "KVCache":
+        """Scatter a host buffer pair into ``device_pages`` and return the
+        rebound pool. The input pool is DONATED — the caller must rebind
+        the result via its ``set_kv`` seam before any other consumer runs
+        (schedule-time only, like a step program's pool; KGCT004)."""
+        n = len(device_pages)
+        idx = self._padded_idx(device_pages)
+        L, _, ps, kd = kv.k.shape
+        k_data = np.zeros((L, len(idx), ps, kd), kv.k.dtype)
+        v_data = np.zeros_like(k_data)
+        k_data[:, :n] = k_np
+        v_data[:, :n] = v_np
+        new_k, new_v = self._scatter_fn(kv.k, kv.v, idx, k_data, v_data)
+        return KVCache(k=new_k, v=new_v)
+
+
+class KVSwapper:
+    """Device<->host page movement for the two-tier KV cache, on the shared
+    :class:`KVTransferPrograms` gather/scatter pair.
+
+    Ordering contracts (KGCT010 polices the static half):
+
+    - ``swap_out`` returns only after ``np.asarray`` fully fetched the
+      gather — the caller may free the device pages immediately after, and
+      the next step's dispatch may consume the donated pool.
+    - ``swap_in``/``restore_page`` scatter through ``get_kv``/``set_kv`` and
+      must only run when no dispatched program is in flight (the engine's
+      schedule-time paths satisfy this; the donated input is dead the moment
+      the call returns, exactly like a step program's pool).
+
+    Padding rows of both transfers are routed to ``SCRAP_PAGE``, which never
+    backs real tokens — a padded scatter write is harmless by construction.
+    """
+
+    def __init__(self, host_pool: HostKVPool,
+                 get_kv: Callable[[], "KVCache"],
+                 set_kv: Callable[["KVCache"], None],
+                 obs=None, jit_enabled: bool = True, kv_sharding=None,
+                 programs: Optional[KVTransferPrograms] = None):
+        self.host = host_pool
+        self._get_kv = get_kv
+        self._set_kv = set_kv
+        self.obs = obs
+        # Optional host-tier reclaim hook (the prefix-spill store registers
+        # one): asked to drop LRU spilled entries when a swap-out needs room
+        # — live-session KV outranks re-computable spilled prefixes.
+        self.reclaim = None
+        # Optional restore notification (the KGCT_SANITIZE KV-slot shadow
+        # registers one): a swapped-in slot is committed history.
+        self.on_restored = None
+        self.programs = programs if programs is not None else \
+            KVTransferPrograms(jit_enabled=jit_enabled,
+                               kv_sharding=kv_sharding)
+
     def _emit(self, direction: str, pages: int, dt: float,
               request_id: str) -> None:
         if self.obs is not None:
@@ -294,12 +339,9 @@ class KVSwapper:
             raise RuntimeError(
                 f"host KV pool full: want {n}, free {self.host.num_free}")
         t0 = time.perf_counter()
-        kv = self._get_kv()
-        k_g, v_g = self._gather_fn(kv.k, kv.v, self._padded_idx(pages))
-        # Fetch COMPLETES here: after this line the device pages are free to
-        # be reallocated and the donated pool free to be consumed.
-        k_np = np.asarray(k_g)[:, :n]
-        v_np = np.asarray(v_g)[:, :n]
+        # Fetch COMPLETES inside gather_pages: after this line the device
+        # pages are free to be reallocated.
+        k_np, v_np = self.programs.gather_pages(self._get_kv(), pages)
         host_pages = self.host.allocate(n)
         self.host.put(host_pages, k_np, v_np)
         self._emit("out", n, time.perf_counter() - t0, request_id)
@@ -313,14 +355,9 @@ class KVSwapper:
         n = len(host_pages)
         assert n == len(device_pages)
         t0 = time.perf_counter()
-        idx = self._padded_idx(device_pages)
-        kv = self._get_kv()
-        L, _, ps, kd = kv.k.shape
-        k_data = np.zeros((L, len(idx), ps, kd), kv.k.dtype)
-        v_data = np.zeros_like(k_data)
-        k_data[:, :n], v_data[:, :n] = self.host.get(host_pages)
-        new_k, new_v = self._scatter_fn(kv.k, kv.v, idx, k_data, v_data)
-        self._set_kv(KVCache(k=new_k, v=new_v))
+        k_np, v_np = self.host.get(host_pages)
+        self._set_kv(self.programs.scatter_pages(
+            self._get_kv(), device_pages, k_np, v_np))
         self.host.free(host_pages)
         self._emit("in", n, time.perf_counter() - t0, request_id)
 
@@ -350,9 +387,63 @@ class KVSwapper:
             self.on_restored(seq)
 
 
+class KVPageIO:
+    """Cross-REPLICA KV page movement: the export/import seam of
+    disaggregated prefill/decode serving (DistServe-style). A prefill
+    replica gathers a finished prefill's committed pages into one
+    contiguous host buffer (``export_pages``); the decode replica scatters
+    the transferred buffer into freshly allocated pages of its own pool
+    (``import_pages``) and the sequence resumes decode directly — the
+    swap-in path, never a prefill replay.
+
+    Same transfer discipline as :class:`KVSwapper` (KGCT010/KGCT013),
+    because it IS the same machinery — both seams delegate to one shared
+    :class:`KVTransferPrograms` pair:
+
+    - ``export_pages`` returns only after ``np.asarray`` fully fetched the
+      gather — the caller may free the device pages immediately after;
+    - ``import_pages`` donates the pool through the scatter and rebinds it
+      via ``set_kv`` before return (schedule-time only, like swap-in).
+
+    This class (with ``KVSwapper``) is the ONLY sanctioned device-fetch of
+    the KV pool: the KGCT013 lint rule fails any ``np.asarray``/device-get
+    of KV pool contents outside this module.
+    """
+
+    def __init__(self, get_kv: Callable[[], "KVCache"],
+                 set_kv: Callable[["KVCache"], None],
+                 programs: KVTransferPrograms):
+        self._get_kv = get_kv
+        self._set_kv = set_kv
+        # Always the engine's shared pair (KVSwapper rides the same one):
+        # a private fallback here would let the two seams' compile families
+        # silently diverge.
+        self.programs = programs
+
+    def export_pages(self, pages: list[int]) -> tuple[np.ndarray, np.ndarray]:
+        """Gather ``pages`` from the device pool into one contiguous host
+        buffer pair ``(k, v)`` of shape ``[L, n, ps, kd]``. The fetch
+        COMPLETES inside this call: after return the device pages are free
+        to be released and reallocated."""
+        return self.programs.gather_pages(self._get_kv(), pages)
+
+    def import_pages(self, device_pages: list[int],
+                     k_np: np.ndarray, v_np: np.ndarray) -> None:
+        """Scatter a transferred buffer pair into freshly allocated device
+        pages. Must only run when no dispatched program is in flight (the
+        engine's schedule-time import path satisfies this); the donated
+        pool is rebound via ``set_kv`` before return."""
+        n = len(device_pages)
+        assert k_np.shape[1] == n and v_np.shape[1] == n
+        self._set_kv(self.programs.scatter_pages(
+            self._get_kv(), device_pages, k_np, v_np))
+
+
 def build_kv_swapper(model: ModelConfig, cache: CacheConfig, kv: "KVCache",
                      get_kv, set_kv, obs=None, jit_enabled: bool = True,
-                     kv_sharding=None) -> Optional[KVSwapper]:
+                     kv_sharding=None,
+                     programs: Optional[KVTransferPrograms] = None
+                     ) -> Optional[KVSwapper]:
     """Size the host tier from ``swap_space_gb`` and build the swapper; None
     (with a loud log) when the budget fits less than one page."""
     if not cache.kv_swap_enabled:
@@ -369,7 +460,7 @@ def build_kv_swapper(model: ModelConfig, cache: CacheConfig, kv: "KVCache",
     logger.info("host KV tier: %d pages x %d tokens (%.2f GB swap space)",
                 num_host, ps, cache.swap_space_gb)
     return KVSwapper(pool, get_kv, set_kv, obs=obs, jit_enabled=jit_enabled,
-                     kv_sharding=kv_sharding)
+                     kv_sharding=kv_sharding, programs=programs)
 
 
 class PrefixCache:

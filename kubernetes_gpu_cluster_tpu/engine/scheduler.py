@@ -139,6 +139,11 @@ class Scheduler:
         # capacity) — the engine drains these into RequestOutputs so a client
         # waiting on the request still sees a finished event.
         self.terminally_finished: list[Sequence] = []
+        # Disaggregated prefill/decode: finished sequences whose pages are
+        # HELD for the KV export seam (seq.hold_kv) — the engine's
+        # export_held/discard_held own the release. Aborts and capacity
+        # terminations release normally and never land here.
+        self.held: dict[str, Sequence] = {}
         # Monotone high-water marks for padded shapes (stats/debug).
         self.num_preemptions = 0
         self.num_preemptions_by_kind = {"recompute": 0, "swap": 0}
@@ -202,7 +207,17 @@ class Scheduler:
     def finish(self, seq: Sequence, reason) -> None:
         seq.status = SequenceStatus.FINISHED
         seq.finish_reason = reason
-        self._release(seq)
+        if (seq.hold_kv and reason != FinishReason.ABORT and seq.pages):
+            # Disaggregated prefill: the committed KV outlives the finish so
+            # the export seam can gather it for the decode replica. Only the
+            # device pages are held (they carry the KV); any host-tier copy
+            # is released — a held sequence never resumes locally.
+            if seq.host_pages and self.swapper is not None:
+                self.swapper.free_host(seq.host_pages)
+                seq.host_pages = []
+            self.held[seq.request_id] = seq
+        else:
+            self._release(seq)
         if seq in self.running:
             self.running.remove(seq)
         self.obs.on_finish(seq, reason)

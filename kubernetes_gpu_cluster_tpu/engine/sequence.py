@@ -44,6 +44,13 @@ class Sequence:
         self.host_pages: list[int] = []
         self.arrival_time = time.monotonic()
         self.first_token_time: Optional[float] = None  # for TTFT metrics
+        # Disaggregated import: the decode-replica-observed TTFT (remote
+        # prefill + KV transfer + import). step() never sees the first-token
+        # transition for an imported sequence — append_token stamps
+        # first_token_time at import — so TTFT-based accounting (histogram,
+        # SLO attainment/goodput gate) must use this span, not
+        # first_token_time - arrival_time (which would read ~0).
+        self.handoff_ttft_s: Optional[float] = None
         # Lifecycle timestamps/counters for the observability layer: first
         # scheduling (queue-wait), terminal time (e2e latency; also the
         # idempotence guard for Observability.on_finish), preemption count
@@ -58,6 +65,11 @@ class Sequence:
         # Prefix-cache lookup done (one per (re)admission — a blocked head is
         # rescheduled many times and must not re-hash/re-fork per call).
         self.prefix_checked = False
+        # Disaggregated prefill/decode: a prefill-replica request whose
+        # committed KV must survive its finish so the export seam can ship
+        # it to a decode replica (scheduler.finish parks it in
+        # ``scheduler.held`` instead of releasing; aborts still release).
+        self.hold_kv = False
 
     @property
     def all_token_ids(self) -> list[int]:

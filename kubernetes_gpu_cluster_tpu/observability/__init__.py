@@ -245,6 +245,20 @@ class Observability:
         self.tracer.emit("first_token", seq.request_id,
                          ttft_ms=round(ttft * 1e3, 2))
 
+    def on_handoff_first_token(self, seq, ttft_s: float) -> None:
+        """Disaggregated import: the first token(s) arrived WITH the KV
+        handoff, so step()'s first-token transition never fires here.
+        ``ttft_s`` is the decode-replica-observed span (remote prefill +
+        transfer + import) — the client-facing quantity; it feeds the TTFT
+        histogram and the SLO window, and is stashed on the sequence so
+        on_finish's goodput gate judges the real latency, not the ~0 of
+        first_token_time - arrival_time."""
+        seq.handoff_ttft_s = ttft_s
+        self.ttft.observe(ttft_s, (_outcome(seq, None),))
+        self.slo.on_first_token(ttft_s)
+        self.tracer.emit("first_token", seq.request_id,
+                         ttft_ms=round(ttft_s * 1e3, 2), handoff=True)
+
     def on_finish(self, seq, reason) -> None:
         """Terminal accounting — idempotent (several engine paths can reach a
         finished sequence: defer/drain, abort-in-flight, capacity kill)."""
@@ -260,7 +274,10 @@ class Observability:
         # group-abort), and counting them would overstate the autoscaler's
         # throughput signal under client churn.
         if seq.first_token_time is not None and outcome != "aborted":
-            self.slo.on_finish(seq.first_token_time - seq.arrival_time, n)
+            ttft = (seq.handoff_ttft_s
+                    if getattr(seq, "handoff_ttft_s", None) is not None
+                    else seq.first_token_time - seq.arrival_time)
+            self.slo.on_finish(ttft, n)
         if seq.first_token_time is not None and n >= 2:
             self.tpot.observe(
                 (seq.finish_time - seq.first_token_time) / (n - 1))

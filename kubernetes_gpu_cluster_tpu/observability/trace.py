@@ -27,13 +27,14 @@ from typing import Optional
 # "mixed" event carries the step's prefill/decode token split, a "spec"
 # event the drafted/accepted draft-token counts. "preempt" carries the
 # preemption kind (recompute|swap) and "swap" a two-tier KV transfer's
-# direction + page count. The router's span stream reuses the same
+# direction + page count, "handoff" a disaggregated KV handoff
+# (side=export|import, outcome/bytes/ms). The router's span stream reuses the same
 # open/close kinds with its own instants: "pick" (policy + replica + owner
 # hit/overflow/remap), "connect_retry" (connect-phase failover), "ttfb"
 # (upstream headers latency), "relay" (stream relay complete, bytes).
 EVENT_KINDS = ("arrival", "queued", "scheduled", "prefill_chunk",
                "first_token", "decode", "mixed", "spec", "preempt",
-               "swap", "resume", "finish", "abort",
+               "swap", "handoff", "resume", "finish", "abort",
                "pick", "connect_retry", "ttfb", "relay")
 
 # Events that OPEN / CLOSE a request's async span in the Perfetto export.

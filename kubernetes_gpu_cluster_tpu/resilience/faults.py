@@ -30,6 +30,9 @@ point (grep for ``inject(`` / ``fault_value(``):
                        forced to ``value`` seconds (deterministic shedding)
 - ``kv_swap_fail``     kv swapper: swap-out raises (two-tier KV cache ->
                        graceful recompute-preemption fallback)
+- ``kv_handoff_fail``  decode replica: the disaggregated KV-handoff pull
+                       raises before contacting the prefill replica ->
+                       graceful local-recompute fallback
 
 Params (all optional): ``p`` fire probability in [0, 1] (default 1; drawn
 from a PRIVATE ``random.Random(seed)`` per rule, so sequences are

@@ -46,6 +46,7 @@ dispatch hangs so kubelet's liveness probe restarts the pod.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import time
 import uuid
@@ -56,13 +57,18 @@ from aiohttp import web
 from ..config import EngineConfig
 from ..config.engine_config import ResilienceConfig
 from ..engine import SamplingParams
+from ..observability import Histogram
 from ..resilience import (AdmissionController, DrainState, ResilienceHub,
                           StepWatchdog)
 from ..resilience.drain import drain_and_notify
+from ..resilience.faults import inject as _inject_fault
 from ..utils import get_logger
 from .async_engine import AsyncLLMEngine
-from .errors import REQUEST_ID_HEADER, valid_request_id
+from .errors import (PREFILL_URL_HEADER, REQUEST_ID_HEADER,
+                     valid_request_id)
 from .errors import overloaded_error as _overloaded
+from .handoff import (HANDOFF_TIMEOUT_S, decode_handoff, encode_handoff,
+                      fetch_handoff, handoff_request_body)
 from .metrics import Metrics
 from .tokenizer import (IncrementalDetokenizer, Tokenizer,
                         apply_chat_template, load_tokenizer)
@@ -72,6 +78,58 @@ logger = get_logger("serving.api")
 # Per-request TTFT budget (milliseconds). Absent -> the config default;
 # both absent -> admit unconditionally (pre-resilience behavior).
 TTFT_BUDGET_HEADER = "x-kgct-ttft-budget-ms"
+
+# Replica roles (disaggregated prefill/decode serving): "both" — the
+# default, byte-identical to the pre-disaggregation server — serves
+# everything; "prefill" dedicates the replica to /internal/kv_handoff
+# exports; "decode" dedicates it to decode resumption (it never serves
+# handoff exports and always honors an inbound prefill-url header).
+REPLICA_ROLES = ("prefill", "decode", "both")
+
+
+class DisaggStats:
+    """Per-role KV-handoff accounting, rendered on /metrics. Zeros when
+    disaggregation is off — a fresh scrape is nan-free by construction,
+    the same contract as every other serving series."""
+
+    def __init__(self, role: str):
+        self.role = role
+        # side="export" (prefill replica serves a handoff) / "import"
+        # (decode replica pulls one); outcome "ok" | "error" | "fallback"
+        # (import degraded to local recompute).
+        self.handoffs: dict[tuple, int] = {}
+        self.kv_bytes = {"export": 0, "import": 0}
+        self.latency = Histogram(
+            "kgct_disagg_handoff_seconds",
+            "KV handoff wall latency (prefill export / decode import)",
+            labels=("side",))
+
+    def on_handoff(self, side: str, outcome: str, n_bytes: int = 0,
+                   duration_s: Optional[float] = None) -> None:
+        key = (side, outcome)
+        self.handoffs[key] = self.handoffs.get(key, 0) + 1
+        self.kv_bytes[side] = self.kv_bytes.get(side, 0) + n_bytes
+        if duration_s is not None:
+            self.latency.observe(duration_s, (side,))
+
+    def render(self) -> list[str]:
+        lines = [
+            "# TYPE kgct_engine_role gauge",
+            f'kgct_engine_role{{role="{self.role}"}} 1',
+            "# TYPE kgct_disagg_handoffs_total counter",
+        ]
+        keys = {("export", "ok"), ("import", "ok"), ("import", "fallback"),
+                ("export", "error")} | set(self.handoffs)
+        for side, outcome in sorted(keys):
+            lines.append(
+                f'kgct_disagg_handoffs_total{{side="{side}",'
+                f'outcome="{outcome}"}} {self.handoffs.get((side, outcome), 0)}')
+        lines.append("# TYPE kgct_disagg_kv_bytes_total counter")
+        for side in ("export", "import"):
+            lines.append(f'kgct_disagg_kv_bytes_total{{side="{side}"}} '
+                         f"{self.kv_bytes.get(side, 0)}")
+        lines.extend(self.latency.render())
+        return lines
 
 
 def _sampling_params(body: dict, eos_token_id: Optional[int],
@@ -124,11 +182,44 @@ def _stops(body: dict) -> list[str]:
 class APIServer:
     def __init__(self, engine: AsyncLLMEngine, tokenizer: Tokenizer,
                  model_name: str,
-                 resilience: Optional[ResilienceConfig] = None):
+                 resilience: Optional[ResilienceConfig] = None,
+                 role: str = "both",
+                 prefill_pool: Optional[list] = None):
+        if role not in REPLICA_ROLES:
+            raise ValueError(f"unknown replica role {role!r} "
+                             f"(known: {', '.join(REPLICA_ROLES)})")
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.metrics = Metrics(engine.engine)
+        self.role = role
+        self.disagg = DisaggStats(role)
+        # Engine-side import failures (no batch seat, no free pages, state
+        # mismatch) surface AFTER the pull was counted outcome="ok" — the
+        # worker degrades to local recompute and reports it here so the
+        # fallback counter reflects replicas that recompute everything.
+        engine.on_import_fallback = (
+            lambda: self.disagg.on_handoff("import", "fallback"))
+        # KV handoff does not compose with multihost SPMD lockstep: an
+        # import/hold on rank 0 alone would desynchronize the followers'
+        # schedulers, so a mesh leader forces plain colocated serving.
+        self._handoff_ok = engine.leader is None
+        # Bounded pull: a single sequence's handoff can never legitimately
+        # exceed the local pool's own byte size (plus header slack) — one
+        # misbehaving prefill replica must not balloon this process.
+        kv = engine.engine.kv_cache
+        self._handoff_max_bytes = int(kv.k.nbytes + kv.v.nbytes) + (1 << 20)
+        # KV-pull allowlist: PREFILL_URL_HEADER reaches this replica from
+        # the router (which strips client-supplied values), but a client
+        # that can reach the pod DIRECTLY (per-pod DNS) could otherwise
+        # point the pull at an arbitrary URL (SSRF + a 120 s bounded-read
+        # slot per request). When the operator names the prefill pool
+        # (--prefill-pool; the renderer wires it from prefillReplicas),
+        # any other URL degrades to local recompute. None = trust the
+        # network boundary (dev/tests).
+        self.prefill_pool = (frozenset(u.rstrip("/") for u in prefill_pool)
+                             if prefill_pool else None)
+        self._http: Optional[Any] = None   # lazy aiohttp.ClientSession
         self._profile_busy = False
         res = resilience or ResilienceConfig()
         self.res_config = res
@@ -161,6 +252,7 @@ class APIServer:
         app = web.Application(middlewares=[self._request_id_mw])
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.router.add_post("/internal/kv_handoff", self.kv_handoff)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.prometheus)
@@ -202,6 +294,8 @@ class APIServer:
         self.watchdog.start()
 
     async def _on_cleanup(self, app: web.Application) -> None:
+        if self._http is not None:
+            await self._http.close()
         self.engine.shutdown()
         self.watchdog.stop()
 
@@ -263,7 +357,7 @@ class APIServer:
 
     async def health(self, request: web.Request) -> web.Response:
         sched = self.engine.engine.scheduler
-        body = {"status": "ok", "model": self.model_name,
+        body = {"status": "ok", "model": self.model_name, "role": self.role,
                 "waiting": len(sched.waiting), "running": len(sched.running),
                 "swapped": len(sched.swapped)}
         if self.drain_state.is_draining:
@@ -276,7 +370,8 @@ class APIServer:
 
     async def prometheus(self, request: web.Request) -> web.Response:
         text = (self.metrics.render()
-                + "\n".join(self.hub.render_prometheus()) + "\n")
+                + "\n".join(self.hub.render_prometheus()) + "\n"
+                + "\n".join(self.disagg.render()) + "\n")
         return web.Response(text=text, content_type="text/plain")
 
     async def trace(self, request: web.Request) -> web.Response:
@@ -349,6 +444,142 @@ class APIServer:
             "object": "list",
             "data": [{"id": self.model_name, "object": "model",
                       "owned_by": "kubernetes-gpu-cluster-tpu"}]})
+
+    def _reserve_rid(self, request: web.Request, rid: str) -> str:
+        """Duplicate-id guard, atomic with the caller's submission (no
+        await between this and the ``generate`` call): a client reusing an
+        in-flight correlation id gets a unique suffix instead of crossing
+        output streams. Loop: the suffixed id is client-predictable too
+        (monotonic counter), so a pre-claimed suffix must re-roll, never
+        proceed unowned. The final id is stored back on the request so the
+        middleware echoes what the engine actually ran."""
+        base = rid
+        while not self.engine.reserve_request_id(rid):
+            rid = f"{base}+{self.engine.next_request_id('dup')}"
+        request["kgct_request_id"] = rid
+        return rid
+
+    # -- disaggregated prefill/decode (KV handoff) ---------------------------
+
+    async def kv_handoff(self, request: web.Request) -> web.Response:
+        """Prefill-replica half of the handoff: run the prompt through the
+        local engine up to its FIRST token (max_tokens clamped to 1 — the
+        phase boundary), hold the committed KV, and return one binary blob
+        (serving/handoff.py) carrying the pages plus the sequence state.
+        The decode replica imports it as committed history and resumes
+        decode directly; the first token samples here with the client's
+        sampling params, so the disaggregated output is byte-identical to
+        a colocated run. Served by ``prefill``/``both`` roles only."""
+        if self.role == "decode" or not self._handoff_ok:
+            return _error(404, f"kv handoff is not served by this replica "
+                               f"(role={self.role})")
+        gate = self._admission_gate(request)
+        if gate is not None:
+            return gate
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        ids = body.get("prompt_token_ids")
+        if (not isinstance(ids, list) or not ids
+                or not all(isinstance(t, int) and not isinstance(t, bool)
+                           for t in ids)):
+            return _error(400, "prompt_token_ids must be a non-empty "
+                               "list of token ids")
+        n_lp, lp_err = _logprobs_requested(body)
+        if lp_err is not None:
+            return lp_err
+        try:
+            params = _sampling_params(body, self.tokenizer.eos_token_id,
+                                      n_logprobs=n_lp)
+        except (TypeError, ValueError) as e:
+            return _error(400, str(e))
+        params = dataclasses.replace(params, max_tokens=1)
+        rid = request.get("kgct_request_id") or self.engine.next_request_id(
+            "handoff")
+        rid = self._reserve_rid(request, rid)
+        t0 = time.perf_counter()
+        complete = exported = False
+        gen = self.engine.generate(rid, ids, params, hold_kv=True)
+        try:
+            async for chunk in gen:
+                if chunk.finished:
+                    complete = True
+                    break
+            state = await self.engine.run_in_worker(
+                lambda e: e.export_held(rid))
+            exported = True
+            payload = encode_handoff(state)
+        except ValueError as e:
+            self.disagg.on_handoff("export", "error")
+            return _error(400, str(e))
+        except KeyError:
+            # Finished without exportable KV (capacity-terminated before
+            # any page committed): the decode side recomputes locally.
+            self.disagg.on_handoff("export", "error")
+            return _overloaded(503, "prefill finished without exportable "
+                                    "KV; recompute locally", 1)
+        except BaseException:
+            # Unexpected failure or client-disconnect cancellation: either
+            # way no blob left this replica — an operator watching a
+            # failing prefill pool must see outcome="error" move, not a
+            # flat ok-counter (the decode side only ever reports its own
+            # fallbacks).
+            self.disagg.on_handoff("export", "error")
+            raise
+        finally:
+            if not self.engine.release_reservation(rid) and not complete:
+                self.engine.abort(rid)
+            if complete and not exported:
+                # Held pages whose export never happened must not leak.
+                self.engine.post_to_worker(lambda e: e.discard_held(rid))
+        dt = time.perf_counter() - t0
+        self.disagg.on_handoff("export", "ok", len(payload), dt)
+        self.engine.engine.obs.tracer.emit(
+            "handoff", rid, side="export", bytes=len(payload),
+            ms=round(dt * 1e3, 2))
+        return web.Response(body=payload,
+                            content_type="application/octet-stream",
+                            headers={REQUEST_ID_HEADER: rid})
+
+    async def _pull_handoff(self, prefill_url: str, rid: str, body: dict,
+                            ids: list[int]) -> Optional[dict]:
+        """Decode-replica half: pull the prefilled KV from ``prefill_url``
+        (bounded read + wall bound, serving/handoff.py) and decode the
+        blob. Returns None on ANY failure — including the deterministic
+        chaos site ``kv_handoff_fail`` — and the caller degrades to local
+        recompute, which is byte-identical, just slower. The fallback
+        trigger lands in the trace ring AND the black-box flight recorder
+        (the tracer mirrors every emit), so a degraded fleet leaves
+        evidence."""
+        import aiohttp
+        obs = self.engine.engine.obs
+        t0 = time.perf_counter()
+        try:
+            if _inject_fault("kv_handoff_fail"):
+                raise RuntimeError("KGCT_FAULT kv_handoff_fail: injected "
+                                   "handoff failure")
+            if self._http is None:
+                self._http = aiohttp.ClientSession()
+            data = await fetch_handoff(
+                self._http, prefill_url, handoff_request_body(ids, body),
+                rid, self._handoff_max_bytes, timeout_s=HANDOFF_TIMEOUT_S)
+            state = decode_handoff(data)
+        except Exception as e:
+            dt = time.perf_counter() - t0
+            logger.warning("kv handoff pull from %s failed (%s); falling "
+                           "back to local prefill", prefill_url, e,
+                           extra={"request_id": rid})
+            self.disagg.on_handoff("import", "fallback", 0, dt)
+            obs.tracer.emit("handoff", rid, side="import",
+                            outcome="fallback", error=str(e)[:200],
+                            ms=round(dt * 1e3, 2))
+            return None
+        dt = time.perf_counter() - t0
+        self.disagg.on_handoff("import", "ok", len(data), dt)
+        obs.tracer.emit("handoff", rid, side="import", outcome="ok",
+                        bytes=len(data), ms=round(dt * 1e3, 2))
+        return state
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -455,24 +686,53 @@ class APIServer:
             return await self._run_n(body, ids, params, kind, rid, created,
                                      n, want_lps, echo_prefix,
                                      best_of=best_of, n_lp=n_lp)
+        # Disaggregated decode: the router names the prefill-pool replica
+        # that should run this prompt's prefill (PREFILL_URL_HEADER); pull
+        # the prefilled KV and import it as committed history. None (pull
+        # failed / chaos kv_handoff_fail / role=prefill) keeps the plain
+        # local-prefill path — byte-identical output either way.
+        handoff = None
+        pull_t0 = None
+        prefill_url = request.headers.get(PREFILL_URL_HEADER)
+        if (prefill_url and self.role != "prefill" and self._handoff_ok
+                and prefill_url.startswith(("http://", "https://"))):
+            if (self.prefill_pool is not None
+                    and prefill_url.rstrip("/") not in self.prefill_pool):
+                # Out-of-pool pull target: never fetch (SSRF guard) — serve
+                # by local recompute and leave evidence, same degradation
+                # as a failed pull.
+                logger.warning("prefill url %s not in --prefill-pool; "
+                               "serving by local prefill", prefill_url,
+                               extra={"request_id": rid})
+                self.disagg.on_handoff("import", "fallback", 0, 0.0)
+                self.engine.engine.obs.tracer.emit(
+                    "handoff", rid, side="import", outcome="fallback",
+                    error="prefill url not in --prefill-pool")
+            else:
+                t0 = time.monotonic()
+                handoff = await self._pull_handoff(prefill_url, rid, body,
+                                                   ids)
+                if handoff is not None:
+                    # import_request turns this into the decode-side TTFT
+                    # sample (remote prefill + transfer + import).
+                    handoff["_ttft_t0"] = t0
+                else:
+                    # Failed pull: the wall time it burned (up to the
+                    # handoff timeout) is client-observed TTFT — backdate
+                    # the recompute admission so the histogram/SLO window
+                    # see the degradation instead of a green post-pull
+                    # arrival stamp.
+                    pull_t0 = t0
         self.metrics.on_request()
 
-        # Duplicate-id guard, atomic with the submission (no await between
-        # reserve and generate): a client reusing an in-flight correlation
-        # id gets a unique suffix instead of crossing output streams. Loop:
-        # the suffixed id is client-predictable too (monotonic counter), so
-        # a pre-claimed suffix must re-roll, never proceed unowned.
-        if not self.engine.reserve_request_id(rid):
-            base = rid
-            while not self.engine.reserve_request_id(rid):
-                rid = f"{base}+{self.engine.next_request_id('dup')}"
-            request["kgct_request_id"] = rid   # middleware echoes final id
+        rid = self._reserve_rid(request, rid)
         # ``complete`` guards the engine-side abort: any early handler exit —
         # asyncio.CancelledError when aiohttp cancels the task on client
         # disconnect, ConnectionResetError mid-SSE-write, any bug — must stop
         # the request on-device, or an abandoned request keeps generating
         # until max_tokens (a device-time leak under client churn).
-        gen = self.engine.generate(rid, ids, params)
+        gen = self.engine.generate(rid, ids, params, handoff=handoff,
+                                   arrival_t0=pull_t0)
         complete = False
         if not stream:
             try:
@@ -780,13 +1040,15 @@ def _error(status: int, message: str) -> web.Response:
 
 def build_server(config: EngineConfig, tokenizer_path: Optional[str] = None,
                  model_name: Optional[str] = None, params=None,
-                 mesh=None, leader=None) -> APIServer:
+                 mesh=None, leader=None, role: str = "both",
+                 prefill_pool: Optional[list] = None) -> APIServer:
     tokenizer = load_tokenizer(tokenizer_path)
     engine = AsyncLLMEngine(config, params=params,
                             eos_token_id=tokenizer.eos_token_id, mesh=mesh,
                             leader=leader)
     return APIServer(engine, tokenizer, model_name or config.model.name,
-                     resilience=config.resilience)
+                     resilience=config.resilience, role=role,
+                     prefill_pool=prefill_pool)
 
 
 def main(argv: Optional[list[str]] = None) -> None:
@@ -878,6 +1140,20 @@ def main(argv: Optional[list[str]] = None) -> None:
                    help="draft length k per spec step (static compile "
                    "shape; each verify step scores k+1 positions per "
                    "sequence)")
+    p.add_argument("--role", choices=list(REPLICA_ROLES), default="both",
+                   help="disaggregated prefill/decode serving: 'prefill' "
+                   "dedicates this replica to running prompts and exporting "
+                   "their KV via /internal/kv_handoff; 'decode' dedicates "
+                   "it to importing prefilled KV and streaming decode; "
+                   "'both' (default) serves colocated, byte-identical to "
+                   "pre-disaggregation behavior. The router wires the "
+                   "pools together (--prefill-replicas)")
+    p.add_argument("--prefill-pool", default=None,
+                   help="comma-separated prefill-replica base URLs this "
+                   "replica may pull KV handoffs from; an x-kgct-prefill-url "
+                   "naming any OTHER url degrades to local recompute (SSRF "
+                   "guard for direct-to-pod traffic). Unset = any url "
+                   "(single-tenant network)")
     p.add_argument("--enforce-eager", action="store_true",
                    help="disable jit compile caching (debug; always slower)")
     p.add_argument("--trust-remote-code", action="store_true",
@@ -985,7 +1261,11 @@ def main(argv: Optional[list[str]] = None) -> None:
             follower_addrs_from_env(),
             heartbeat_interval_s=config.resilience.heartbeat_interval_s)
     server = build_server(config, args.tokenizer, args.model, params=params,
-                          mesh=mesh, leader=leader)
+                          mesh=mesh, leader=leader, role=args.role,
+                          prefill_pool=([u.strip() for u in
+                                         args.prefill_pool.split(",")
+                                         if u.strip()]
+                                        if args.prefill_pool else None))
     app = server.build_app()
 
     async def _arm_sigterm(app_):

@@ -63,6 +63,29 @@ class AsyncLLMEngine:
         self._reserved: set = set()
         self._inbox: list = []            # (request_id, token_ids, params)
         self._aborts: list[str] = []
+        # Disaggregated prefill/decode side-channels, keyed by request id so
+        # the inbox tuples keep the exact shape the multihost directive
+        # broadcast serializes: _handoffs holds a decoded KV-handoff state
+        # an inbox entry should IMPORT instead of prefilling; _holds marks
+        # entries whose finished KV the export seam will collect. Both are
+        # leader-gated at generate() — handoff does not compose with SPMD
+        # lockstep (followers would never see the import).
+        self._handoffs: dict[str, dict] = {}
+        self._holds: set = set()
+        # Backdated arrival stamps (time.monotonic) for requests whose
+        # handoff pull FAILED before admission: the burned pull wait is
+        # client-observed TTFT and must reach the histogram/SLO window.
+        self._arrival_t0s: dict[str, float] = {}
+        # Serving-layer hook: an ENGINE-side import failure (no batch seat,
+        # no pages, state mismatch) degrades to local recompute after the
+        # pull was already accounted — without this the operator's fallback
+        # counter reads 100% successful imports on a replica that recomputes
+        # everything. Set by APIServer; called on the worker thread.
+        self.on_import_fallback = None
+        # Worker-thread operations (the export seam): (fn(engine), future)
+        # pairs executed between steps, where every engine/scheduler/device
+        # touch is single-threaded by construction.
+        self._ops: list = []
         self._cv = threading.Condition()
         self._shutdown = False
         self._counter = itertools.count()
@@ -134,14 +157,26 @@ class AsyncLLMEngine:
         return False
 
     async def generate(self, request_id: str, prompt_token_ids: list[int],
-                       params: SamplingParams) -> AsyncIterator[StreamChunk]:
+                       params: SamplingParams, handoff: dict = None,
+                       hold_kv: bool = False,
+                       arrival_t0: Optional[float] = None
+                       ) -> AsyncIterator[StreamChunk]:
         """Submit a request and yield StreamChunks until finished.
 
         Id contract: serving callers reserve the id first (see
         reserve_request_id, looped until owned); a DIRECT caller must use
         an id it knows to be unique — calling with an id that has a
         pending reservation would consume the reserver's slot (there is
-        one namespace, no per-claimant tokens)."""
+        one namespace, no per-claimant tokens).
+
+        Disaggregated prefill/decode: ``handoff`` carries a decoded
+        KV-handoff state (serving/handoff.py) — the worker IMPORTS it as
+        committed history and only falls back to a normal admission
+        (local recompute, byte-identical) when the import fails.
+        ``hold_kv`` marks a prefill-replica request whose finished KV the
+        export seam collects (run_in_worker -> engine.export_held). Both
+        are ignored under a multihost leader: import/hold on rank 0 alone
+        would desynchronize the SPMD lockstep."""
         if request_id in self._reserved:
             # Consume the slot reserve_request_id claimed for us.
             self._reserved.discard(request_id)
@@ -154,6 +189,13 @@ class AsyncLLMEngine:
             queue = asyncio.Queue()
             self._queues[request_id] = queue
         with self._cv:
+            if self.leader is None:
+                if handoff is not None:
+                    self._handoffs[request_id] = handoff
+                if hold_kv:
+                    self._holds.add(request_id)
+                if arrival_t0 is not None:
+                    self._arrival_t0s[request_id] = arrival_t0
             self._inbox.append((request_id, prompt_token_ids, params))
             self._cv.notify()
         try:
@@ -172,23 +214,85 @@ class AsyncLLMEngine:
             self._aborts.append(request_id)
             self._cv.notify()
 
+    def run_in_worker(self, fn):
+        """Awaitable execution of ``fn(engine)`` on the worker thread —
+        the one place engine/scheduler/device state may be touched outside
+        step() without racing it (the KV export seam runs here). The
+        result (or exception) resolves the returned awaitable."""
+        import concurrent.futures
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self._cv:
+            if self._worker_dead():
+                # An op enqueued after the worker's final wakeup would never
+                # drain and its awaiter would hang forever.
+                fut.set_exception(RuntimeError("engine shut down"))
+            else:
+                self._ops.append((fn, fut))
+                self._cv.notify()
+        return asyncio.wrap_future(fut)
+
+    def post_to_worker(self, fn) -> None:
+        """Fire-and-forget variant of :meth:`run_in_worker` (cleanup from
+        handler ``finally`` blocks, where awaiting mid-cancellation is
+        unsafe)."""
+        with self._cv:
+            if self._worker_dead():
+                # Engine-side state the op would have cleaned dies with the
+                # process anyway; dropping loudly beats a silent no-op.
+                logger.warning("worker op dropped: engine shut down")
+                return
+            self._ops.append((fn, None))
+            self._cv.notify()
+
+    def _worker_dead(self) -> bool:
+        """Caller holds ``_cv``. True once no future wakeup can drain
+        ``_ops``: shutdown requested (the worker's final wakeup fails
+        whatever it captured — anything appended later is unreachable), or
+        the thread exited (step-crash path; it flags ``_shutdown`` too,
+        this also covers a crash mid-unwind)."""
+        return self._shutdown or (self._thread.ident is not None
+                                  and not self._thread.is_alive())
+
     # -- worker thread -------------------------------------------------------
 
     def _worker(self) -> None:
         while True:
             with self._cv:
                 while not (self._shutdown or self._inbox or self._aborts
+                           or self._ops
                            or self.engine.has_unfinished_requests()):
                     self._cv.wait()
-                if self._shutdown:
-                    return
                 inbox, self._inbox = self._inbox, []
                 aborts, self._aborts = self._aborts, []
+                ops, self._ops = self._ops, []
+                if self._shutdown:
+                    # Fail pending worker ops loudly: an awaiting export
+                    # must not hang past the thread's death.
+                    for _, fut in ops:
+                        if fut is not None:
+                            fut.set_exception(
+                                RuntimeError("engine shut down"))
+                    return
+            for fn, fut in ops:
+                try:
+                    result = fn(self.engine)
+                except BaseException as e:
+                    if fut is not None:
+                        fut.set_exception(e)
+                    else:
+                        logger.exception("worker op failed")
+                else:
+                    if fut is not None:
+                        fut.set_result(result)
             # A request whose add and abort arrived in the same wakeup must
             # not be admitted: the abort would no-op (nothing to abort yet)
             # and the request would then run orphaned to completion.
             aborted = set(aborts)
             inbox = [item for item in inbox if item[0] not in aborted]
+            for rid in aborted:
+                self._handoffs.pop(rid, None)
+                self._holds.discard(rid)
+                self._arrival_t0s.pop(rid, None)
             if self.leader is not None:
                 # Replicate this iteration's events to follower ranks BEFORE
                 # stepping: their engines apply the same events and step
@@ -236,8 +340,40 @@ class AsyncLLMEngine:
                 self.engine.abort_request(rid)
                 self._post(StreamChunk(rid, [], [], True, "abort"))
             for rid, ids, params in inbox:
+                handoff = self._handoffs.pop(rid, None)
+                arrival_t0 = self._arrival_t0s.pop(rid, None)
+                hold = rid in self._holds
+                self._holds.discard(rid)
                 try:
-                    self.engine.add_request(rid, ids, params)
+                    if handoff is not None:
+                        # import_request pops the stamp; keep a copy so an
+                        # ENGINE-side import failure backdates the recompute
+                        # admission the same way a failed pull does.
+                        if arrival_t0 is None:
+                            arrival_t0 = handoff.get("_ttft_t0")
+                        try:
+                            for out in self.engine.import_request(
+                                    rid, ids, params, handoff):
+                                self._post(_chunk_of(out))
+                            continue
+                        except Exception as e:
+                            # Degrade to local recompute — byte-identical,
+                            # just slower; the trace records the fallback.
+                            logger.warning(
+                                "kv import for %s failed (%s); falling back"
+                                " to local prefill", rid, e,
+                                extra={"request_id": rid})
+                            self.engine.obs.tracer.emit(
+                                "handoff", rid, side="import",
+                                outcome="import_fallback", error=str(e))
+                            if self.on_import_fallback is not None:
+                                try:
+                                    self.on_import_fallback()
+                                except Exception:
+                                    logger.exception(
+                                        "import-fallback hook failed")
+                    self.engine.add_request(rid, ids, params, hold_kv=hold,
+                                            arrival_t0=arrival_t0)
                 except ValueError as e:   # oversized prompt etc.
                     self._post_exc(rid, e)
             if self.engine.has_unfinished_requests():
@@ -260,6 +396,17 @@ class AsyncLLMEngine:
                         wd.mark_dead(f"engine step raised: {e}")
                     for rid in list(self._queues):
                         self._post_exc(rid, e)
+                    # The loop is exiting for good: flag shutdown and fail
+                    # any ops racing this unwind, so run_in_worker callers
+                    # (KV export handlers) never await a drained-by-nobody
+                    # future.
+                    with self._cv:
+                        self._shutdown = True
+                        ops, self._ops = self._ops, []
+                    for _, fut in ops:
+                        if fut is not None:
+                            fut.set_exception(
+                                RuntimeError(f"engine step raised: {e}"))
                     return
                 if wd is not None:
                     wd.disarm()

@@ -23,6 +23,15 @@ from aiohttp import web
 
 REQUEST_ID_HEADER = "x-kgct-request-id"
 
+# Disaggregated prefill/decode: the router names the prefill-pool replica a
+# decode replica should pull prefilled KV from (serving/handoff.py). Set by
+# the ROUTER only — the proxy strips any client-supplied value. Traffic
+# that reaches a replica pod DIRECTLY (per-pod DNS) bypasses that strip,
+# so the replica enforces its own boundary: with ``--prefill-pool`` set
+# (the renderer wires it from prefillReplicas), a header naming any other
+# url is never fetched — the request degrades to local recompute.
+PREFILL_URL_HEADER = "x-kgct-prefill-url"
+
 # Ids must be safe to echo into headers, log records, and trace JSON: a
 # bounded charset, no whitespace/control bytes, bounded length. Anything
 # else is treated as absent and a fresh id is minted.

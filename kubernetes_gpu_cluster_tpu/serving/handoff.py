@@ -1,0 +1,161 @@
+"""KV-handoff wire protocol for disaggregated prefill/decode serving.
+
+The decode replica pulls a finished prefill's committed KV pages plus the
+sequence state from the prefill replica's ``POST /internal/kv_handoff``
+endpoint and imports them as committed history (``LLMEngine.import_request``
+— the swap-in path, no prefill replay). This module owns the two halves the
+api_server composes:
+
+- the BLOB codec: one self-describing binary frame — magic, a bounded JSON
+  header (sequence state + array shapes/dtype), then the raw ``k`` and
+  ``v`` buffer bytes. No pickle anywhere: the decode side reconstructs the
+  arrays with ``np.frombuffer`` from the header's declared shape/dtype, so
+  a malicious or corrupt payload can fail validation but never execute.
+  ``tobytes``/``frombuffer`` round-trip every dtype the pool can use,
+  including ``bfloat16`` (ml_dtypes registers it with numpy);
+- the BOUNDED fetch: the puller caps both the response size (a handoff can
+  never legitimately exceed the local pool's own byte size) and the wall
+  time, so one wedged prefill replica cannot hang or balloon a decode
+  replica — any failure degrades to local recompute, which is
+  byte-identical, just slower (chaos site ``kv_handoff_fail`` forces that
+  path deterministically).
+
+Everything here is engine-free and jax-free so tests can pin the codec and
+the fetch discipline without building an engine.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import aiohttp
+import numpy as np
+
+from .errors import REQUEST_ID_HEADER
+
+# Frame: MAGIC + u32 header length + JSON header + k bytes + v bytes.
+HANDOFF_MAGIC = b"KGCT-KV1"
+# A JSON header larger than this is corrupt, not big: it carries token id
+# lists and scalars, never KV content.
+HEADER_MAX_BYTES = 8 << 20
+# Wall bound for one pull (connect + prefill compute + transfer). Generous:
+# the prefill replica may be running a long prompt; a decode replica that
+# gives up just recomputes locally.
+HANDOFF_TIMEOUT_S = 120.0
+
+# Client body fields the decode replica forwards so the prefill replica
+# samples the FIRST token exactly as a colocated engine would (penalties see
+# no output yet; seed/temperature/bias shape the very first sample).
+FORWARDED_SAMPLING_FIELDS = (
+    "temperature", "top_p", "top_k", "seed", "presence_penalty",
+    "frequency_penalty", "logit_bias", "stop_token_ids", "logprobs",
+)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype NAME (including the ml_dtypes families numpy alone
+    does not know, e.g. bfloat16) without importing jax."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_handoff(state: dict) -> bytearray:
+    """Engine export dict (``LLMEngine.export_held``) -> one binary frame.
+
+    The arrays are copied straight into their slices of one preallocated
+    buffer — no ``tobytes`` temporaries, no join copy — so a concurrent
+    burst of exports peaks at the frames themselves, not ~3x the KV bytes
+    (returns ``bytearray`` for that reason; every consumer — aiohttp
+    response body, ``decode_handoff`` — takes any bytes-like)."""
+    k, v = state["k"], state["v"]
+    header = dict(state)
+    header.pop("k")
+    header.pop("v")
+    header["k_shape"] = list(k.shape)
+    header_bytes = json.dumps(header).encode()
+    off = len(HANDOFF_MAGIC) + 4 + len(header_bytes)
+    out = bytearray(off + k.nbytes + v.nbytes)
+    out[:off] = HANDOFF_MAGIC + struct.pack(">I", len(header_bytes)) \
+        + header_bytes
+    view = memoryview(out)
+    np.copyto(np.frombuffer(view, k.dtype, count=k.size,
+                            offset=off).reshape(k.shape), k)
+    np.copyto(np.frombuffer(view, v.dtype, count=v.size,
+                            offset=off + k.nbytes).reshape(v.shape), v)
+    return out
+
+
+def decode_handoff(data: bytes | bytearray) -> dict:
+    """Binary frame -> the engine import state dict. Raises ValueError on
+    any structural mismatch (truncated frame, oversized header, byte-count
+    drift) — the caller treats that as a failed handoff and recomputes."""
+    m = len(HANDOFF_MAGIC)
+    if data[:m] != HANDOFF_MAGIC:
+        raise ValueError("handoff blob: bad magic")
+    if len(data) < m + 4:
+        raise ValueError("handoff blob: truncated header length")
+    (hlen,) = struct.unpack(">I", data[m:m + 4])
+    if hlen > HEADER_MAX_BYTES:
+        raise ValueError(f"handoff blob: header {hlen} bytes exceeds bound")
+    off = m + 4
+    try:
+        header = json.loads(data[off:off + hlen])
+    except ValueError as e:
+        raise ValueError(f"handoff blob: bad header JSON ({e})") from None
+    off += hlen
+    shape = tuple(int(d) for d in header.pop("k_shape"))
+    dtype = _np_dtype(str(header["dtype"]))
+    nbytes = int(np.prod(shape)) * dtype.itemsize
+    if len(data) != off + 2 * nbytes:
+        raise ValueError(
+            f"handoff blob: payload {len(data) - off} bytes != 2 x {nbytes}")
+    header["k"] = np.frombuffer(data, dtype, count=int(np.prod(shape)),
+                                offset=off).reshape(shape)
+    header["v"] = np.frombuffer(data, dtype, count=int(np.prod(shape)),
+                                offset=off + nbytes).reshape(shape)
+    return header
+
+
+def handoff_request_body(prompt_token_ids: list, body: dict) -> dict:
+    """The JSON body a decode replica sends the prefill replica: the
+    already-tokenized prompt (the prefill side must not re-tokenize — text
+    normalization drift would change the KV) plus the sampling fields that
+    shape the first token."""
+    fwd = {"prompt_token_ids": list(prompt_token_ids)}
+    for field in FORWARDED_SAMPLING_FIELDS:
+        if field in body and body[field] is not None:
+            fwd[field] = body[field]
+    return fwd
+
+
+async def fetch_handoff(session: aiohttp.ClientSession, prefill_url: str,
+                        payload: dict, request_id: str, max_bytes: int,
+                        timeout_s: float = HANDOFF_TIMEOUT_S) -> bytes:
+    """POST the handoff request and read the blob with both bounds applied.
+    Raises on any non-200, oversized, or timed-out response — the caller
+    falls back to local recompute."""
+    async with session.post(
+            f"{prefill_url.rstrip('/')}/internal/kv_handoff", json=payload,
+            headers={REQUEST_ID_HEADER: request_id},
+            timeout=aiohttp.ClientTimeout(total=timeout_s)) as resp:
+        if resp.status != 200:
+            # Bounded error peek: the envelope is small; never slurp an
+            # unbounded error body into memory.
+            snippet = (await resp.content.read(2048)).decode(
+                "utf-8", errors="replace")
+            raise RuntimeError(
+                f"handoff upstream {resp.status}: {snippet[:200]}")
+        if resp.content_length is not None and \
+                resp.content_length > max_bytes:
+            raise RuntimeError(
+                f"handoff blob {resp.content_length} bytes exceeds the "
+                f"local bound {max_bytes}")
+        data = await resp.content.read(max_bytes + 1)
+        if len(data) > max_bytes:
+            raise RuntimeError(
+                f"handoff blob exceeds the local bound {max_bytes}")
+        return data

@@ -89,7 +89,8 @@ from ..resilience.faults import inject as _inject_fault
 from ..utils import get_logger
 # The engine's shed/drain responses use the same envelope (serving.errors):
 # a router-level 503 is handled by the identical client code path.
-from .errors import REQUEST_ID_HEADER, valid_request_id
+from .errors import (PREFILL_URL_HEADER, REQUEST_ID_HEADER,
+                     valid_request_id)
 from .errors import overloaded_error as _proxy_error
 
 logger = get_logger("serving.router")
@@ -193,7 +194,8 @@ class Router:
                  affinity_prefix_len: int = 32,
                  balance_factor: float = 1.5,
                  ring_vnodes: int = RING_VNODES,
-                 trace_timeout_s: float = 5.0):
+                 trace_timeout_s: float = 5.0,
+                 prefill_urls: Optional[list[str]] = None):
         if routing_policy not in ("least-inflight", "prefix-affinity"):
             raise ValueError(f"unknown routing_policy {routing_policy!r} "
                              "(known: least-inflight, prefix-affinity)")
@@ -207,6 +209,18 @@ class Router:
         self.balance_factor = balance_factor
         self.ring = HashRing([r.url for r in self.replicas],
                              vnodes=ring_vnodes)
+        # Disaggregated prefill/decode: a second, phase-dedicated pool.
+        # Completion requests are proxied to the MAIN pool (role "decode"
+        # when this pool exists, "both" otherwise) with an
+        # x-kgct-prefill-url header naming the prefill-pool replica picked
+        # by PREFIX-affinity on its own ring — prefill replicas are keyed
+        # by prompt prefix (cache locality), decode replicas by session.
+        # The decode replica pulls the prefilled KV itself; the router
+        # never carries KV bytes.
+        self.prefill_replicas = [Replica(u) for u in (prefill_urls or [])]
+        self.prefill_ring = (HashRing([r.url for r in self.prefill_replicas],
+                                      vnodes=ring_vnodes)
+                             if self.prefill_replicas else None)
         # Affinity accounting (rendered on /metrics): a pick is a "hit" when
         # the key landed on its ring owner, an "overflow" (labeled by the
         # owner that was over-bound) when the bounded-load walk moved past
@@ -290,7 +304,8 @@ class Router:
         # the periodic loop notices. One failed startup probe removes it
         # immediately; the loop restores it on recovery.
         await asyncio.gather(
-            *(self._check(r, startup=True) for r in self.replicas),
+            *(self._check(r, startup=True)
+              for r in self.replicas + self.prefill_replicas),
             return_exceptions=True)
         self._health_task = asyncio.create_task(self._health_loop())
 
@@ -305,8 +320,10 @@ class Router:
     async def _health_loop(self) -> None:
         while True:
             await asyncio.sleep(self.health_interval_s)
-            await asyncio.gather(*(self._check(r) for r in self.replicas),
-                                 return_exceptions=True)
+            await asyncio.gather(
+                *(self._check(r)
+                  for r in self.replicas + self.prefill_replicas),
+                return_exceptions=True)
             # Flight-recorder fleet snapshot (per-replica inflight/health)
             # rides the existing periodic loop — no extra timer.
             self.flight.maybe_snapshot()
@@ -315,8 +332,8 @@ class Router:
         """O(1) state reader for the flight recorder: the router's view of
         fleet load at this instant (attribute reads only)."""
         return {
-            "inflight": {r.url: r.inflight for r in self.replicas},
-            "healthy": [r.url for r in self.replicas if r.healthy],
+            "inflight": {r.url: r.inflight for r, _ in self._pools()},
+            "healthy": [r.url for r, _ in self._pools() if r.healthy],
             "retries_total": self.retries_total,
         }
 
@@ -339,7 +356,7 @@ class Router:
         injector = _get_injector()
         if injector is not None:
             rule = injector.rules.get("replica_down")
-            if (rule is not None
+            if (rule is not None and replica in self.replicas
                     and self.replicas.index(replica) == int(rule.value)
                     and rule.should_fire()):
                 logger.warning("KGCT_FAULT replica_down: probe of %s "
@@ -368,23 +385,39 @@ class Router:
                                " (startup probe)" if startup else "")
                 replica.healthy = False
 
+    def _pools(self) -> list[tuple[Replica, str]]:
+        """Every replica the router owns, with its pool role: the main
+        pool serves decode streams ("decode" when a prefill pool exists,
+        the pre-disaggregation "both" otherwise), the prefill pool serves
+        KV-handoff exports. One scrape separates the pools by the role
+        label."""
+        main_role = "decode" if self.prefill_replicas else "both"
+        return ([(r, main_role) for r in self.replicas]
+                + [(r, "prefill") for r in self.prefill_replicas])
+
     async def health(self, request: web.Request) -> web.Response:
         healthy = [r.url for r in self.replicas if r.healthy]
         status = 200 if healthy else 503
         return web.json_response(
             {"status": "ok" if healthy else "no healthy replicas",
              "replicas": {r.url: {"healthy": r.healthy,
-                                  "inflight": r.inflight}
-                          for r in self.replicas}},
+                                  "inflight": r.inflight,
+                                  "role": role}
+                          for r, role in self._pools()}},
             status=status)
 
     async def metrics(self, request: web.Request) -> web.Response:
+        # Per-replica gauges carry the POOL role (prefill|decode|both) so
+        # one scrape separates prefill-pool from decode-pool health under
+        # disaggregated serving; a non-disaggregated fleet renders the
+        # pre-existing "both" everywhere.
+        pools = self._pools()
         lines = ["# TYPE kgct_router_replica_healthy gauge"]
-        lines += [f'kgct_router_replica_healthy{{replica="{r.url}"}} '
-                  f"{int(r.healthy)}" for r in self.replicas]
+        lines += [f'kgct_router_replica_healthy{{replica="{r.url}",'
+                  f'role="{role}"}} {int(r.healthy)}' for r, role in pools]
         lines.append("# TYPE kgct_router_replica_inflight gauge")
-        lines += [f'kgct_router_replica_inflight{{replica="{r.url}"}} '
-                  f"{r.inflight}" for r in self.replicas]
+        lines += [f'kgct_router_replica_inflight{{replica="{r.url}",'
+                  f'role="{role}"}} {r.inflight}' for r, role in pools]
         lines += ["# TYPE kgct_router_retries_total counter",
                   f"kgct_router_retries_total {self.retries_total}"]
         # Routing-policy surface: which policy is live (info-style gauge)
@@ -414,7 +447,7 @@ class Router:
         # replica so series do not collide. Each per-replica fetch is bounded
         # (metrics_timeout_s): one stalled replica must not hang the whole
         # scrape — stragglers are skipped and counted instead.
-        scraped = [r for r in self.replicas if r.healthy]
+        scraped = [r for r, _ in pools if r.healthy]
         fetched = await asyncio.gather(
             *(self._fetch_metrics(r) for r in scraped),
             return_exceptions=True)
@@ -434,7 +467,7 @@ class Router:
         # predates the series — a fresh scrape is nan-free by construction.
         locality = {r.url: {"kgct_prefix_cache_hit_ratio": 0.0,
                             "kgct_num_swapped": 0.0}
-                    for r in self.replicas}
+                    for r, _ in pools}
         for replica, res in zip(scraped, fetched):
             if isinstance(res, BaseException):
                 continue
@@ -454,8 +487,9 @@ class Router:
             lines.append(f"# TYPE kgct_router_replica_{name.removeprefix('kgct_')} gauge")
             lines += [
                 f'kgct_router_replica_{name.removeprefix("kgct_")}'
-                f'{{replica="{r.url}"}} {locality[r.url][name]}'
-                for r in self.replicas]
+                f'{{replica="{r.url}",role="{role}"}} '
+                f'{locality[r.url][name]}'
+                for r, role in pools]
         # Regroup by metric family: the text exposition format requires ONE
         # TYPE line per family with ALL its samples contiguous — appending
         # replicas' expositions sequentially interleaves families and strict
@@ -524,7 +558,7 @@ class Router:
         skipped and counted in kgct_router_trace_scrape_errors_total, same
         discipline as the metrics scrape."""
         docs = [("kgct-router", self.tracer.export_perfetto())]
-        scraped = [r for r in self.replicas if r.healthy]
+        scraped = [r for r, _ in self._pools() if r.healthy]
         fetched = await asyncio.gather(
             *(self._fetch_trace(r) for r in scraped),
             return_exceptions=True)
@@ -551,9 +585,12 @@ class Router:
 
     def _pick(self, exclude: Optional[set] = None,
               include_unhealthy: bool = False,
-              affinity_key: Optional[bytes] = None) -> Optional[Replica]:
+              affinity_key: Optional[bytes] = None,
+              pool: Optional[list] = None,
+              ring: Optional[HashRing] = None) -> Optional[Replica]:
         """The ONE replica-selection seam (every proxy attempt, including
-        retry-with-exclude and desperation rounds, calls here — KGCT011).
+        retry-with-exclude, desperation rounds, and the prefill-pool pick
+        of disaggregated serving, calls here — KGCT011).
 
         ``affinity_key`` engages the prefix-affinity policy: walk the ring
         from the key's owner, skipping out-of-rotation replicas, and take
@@ -561,40 +598,53 @@ class Router:
         ``ceil(balance_factor * (total_inflight + 1) / n_candidates)``.
         All-over-bound (a bound < 1 is impossible, so this means real
         saturation) falls through to least-inflight over the same
-        candidates — the policy degrades, it never refuses."""
-        healthy = [r for r in self.replicas
+        candidates — the policy degrades, it never refuses.
+
+        ``pool``/``ring`` select a phase-dedicated pool instead of the main
+        one (the disaggregated PREFILL pool). A non-main pool walks its
+        ring whenever a key exists REGARDLESS of the configured policy —
+        prefill replicas are keyed by prompt prefix by construction — and
+        its picks stay out of the affinity counters (which account the
+        client-facing pool)."""
+        main = pool is None
+        replicas = self.replicas if pool is None else pool
+        ring = self.ring if ring is None else ring
+        healthy = [r for r in replicas
                    if (r.healthy or include_unhealthy)
                    and (not exclude or r.url not in exclude)]
         self._pick_info = {"policy": self.routing_policy, "pick": "none"}
         if not healthy:
             return None
         if (affinity_key is not None
-                and self.routing_policy == "prefix-affinity"):
+                and (self.routing_policy == "prefix-affinity" or not main)):
             candidates = {r.url: r for r in healthy}
             bound = math.ceil(
                 self.balance_factor
                 * (sum(r.inflight for r in healthy) + 1) / len(healthy))
-            owner_url = self.ring.owner(affinity_key)
-            self.affinity_requests_total += 1
-            if owner_url not in candidates:
-                # Owner unhealthy/benched/excluded: its keys remap to ring
-                # successors until it returns (deterministic, and only ITS
-                # keys move).
-                self.ring_remaps_total += 1
-            for url in self.ring.walk(affinity_key):
+            owner_url = ring.owner(affinity_key)
+            if main:
+                self.affinity_requests_total += 1
+                if owner_url not in candidates:
+                    # Owner unhealthy/benched/excluded: its keys remap to
+                    # ring successors until it returns (deterministic, and
+                    # only ITS keys move).
+                    self.ring_remaps_total += 1
+            for url in ring.walk(affinity_key):
                 replica = candidates.get(url)
                 if replica is None:
                     continue
                 if replica.inflight + 1 <= bound:
                     if url == owner_url:
-                        self.affinity_hits_total += 1
+                        if main:
+                            self.affinity_hits_total += 1
                         self._pick_info["pick"] = "affinity_hit"
                     elif owner_url in candidates:
                         # Owner was available but over-bound: the hot-key
                         # spillover the balance factor exists to allow.
-                        self.affinity_overflow_total[owner_url] = (
-                            self.affinity_overflow_total.get(owner_url, 0)
-                            + 1)
+                        if main:
+                            self.affinity_overflow_total[owner_url] = (
+                                self.affinity_overflow_total.get(
+                                    owner_url, 0) + 1)
                         self._pick_info["pick"] = "affinity_overflow"
                         self._pick_info["owner"] = owner_url
                     else:
@@ -609,7 +659,7 @@ class Router:
         self._pick_info["pick"] = "least_inflight"
         return tied[seq % len(tied)]
 
-    def _affinity_key(self, body: bytes) -> Optional[bytes]:
+    def _affinity_key(self, body: bytes, force: bool = False) -> Optional[bytes]:
         """Derive the routing key from an already-buffered request body —
         the proxy reads the full body before forwarding anyway (it may
         re-send it on connect-phase failover), so the peek adds no latency
@@ -623,14 +673,32 @@ class Router:
         ``4 * affinity_prefix_len`` UTF-8 bytes of a text prompt / chat
         messages serialization (~4 bytes per token, so both spellings key
         on a comparable prefix window). None (no key derivable) routes
-        least-inflight."""
-        if self.routing_policy != "prefix-affinity" or not body:
+        least-inflight.
+
+        ``force`` derives the key regardless of the configured policy —
+        the disaggregated PREFILL pool is always prefix-keyed, even when
+        the client-facing pool balances least-inflight."""
+        if self.routing_policy != "prefix-affinity" and not force:
+            return None
+        return self._affinity_key_from_obj(self._parse_json_dict(body))
+
+    @staticmethod
+    def _parse_json_dict(body: bytes) -> Optional[dict]:
+        """Parse an already-buffered request body into the JSON object
+        every routing peek keys off — parsed ONCE per request in proxy()
+        and shared, so a long-prompt body is never scanned twice on the
+        single-threaded event loop. None for empty/unparseable/non-object
+        bodies (the replica's fast 400 to give, not the router's)."""
+        if not body:
             return None
         try:
             obj = json.loads(body)
         except (ValueError, UnicodeDecodeError):
             return None
-        if not isinstance(obj, dict):
+        return obj if isinstance(obj, dict) else None
+
+    def _affinity_key_from_obj(self, obj: Optional[dict]) -> Optional[bytes]:
+        if obj is None:
             return None
         for field in ("session_id", "user"):
             val = obj.get(field)
@@ -658,6 +726,26 @@ class Router:
             return b"chat:" + ser.encode("utf-8")[:text_window]
         return None
 
+    @staticmethod
+    def _handoff_eligible(obj: Optional[dict]) -> bool:
+        """Whether this request can consume a KV handoff on the decode
+        side. ``n``/``best_of`` > 1 requests fan out through the replica's
+        ``_run_n`` BEFORE its handoff block — no pull ever happens — so a
+        prefill pick would hold a phantom pull slot for the request's
+        whole lifetime and skew the prefill ring's bounded-load math.
+        Anything not positively multi-sequence (including bodies
+        ``_parse_json_dict`` rejected — the replica's fast 400 to give)
+        stays eligible: same behavior as today, and a slot held across a
+        400 is noise."""
+        if obj is None:
+            return True
+        try:
+            n = 1 if obj.get("n") is None else int(obj["n"])
+            best_of = n if obj.get("best_of") is None else int(obj["best_of"])
+        except (TypeError, ValueError):
+            return True
+        return n <= 1 and best_of <= 1
+
     async def proxy(self, request: web.Request) -> web.StreamResponse:
         """Reverse-proxy with failover.
 
@@ -682,9 +770,56 @@ class Router:
         rid = valid_request_id(request.headers.get(REQUEST_ID_HEADER))
         if rid is None:
             rid = "req-" + uuid.uuid4().hex[:20]
-        akey = self._affinity_key(body)
+        # Parse the body ONCE: the main-pool affinity key and both
+        # prefill-pool peeks below share the object (configs needing
+        # neither never parse at all).
+        disagg_post = bool(self.prefill_replicas
+                           and request.method == "POST"
+                           and request.path.endswith("/completions"))
+        obj = self._parse_json_dict(body) \
+            if (self.routing_policy == "prefix-affinity" or disagg_post) \
+            else None
+        akey = self._affinity_key_from_obj(obj) \
+            if self.routing_policy == "prefix-affinity" else None
         self.tracer.emit("arrival", rid, path=request.path,
                          policy=self.routing_policy, bytes=len(body))
+        # Disaggregated serving: pick the prefill-pool replica ONCE per
+        # request (prefix-affinity on the prefill ring — always keyed,
+        # whatever the main policy) and name it in the forwarded header;
+        # the decode replica pulls the KV itself. No healthy prefill
+        # replica -> no header -> the decode replica prefills locally.
+        pr = None
+        if disagg_post and self._handoff_eligible(obj):
+            pkey = akey if self.routing_policy == "prefix-affinity" \
+                else self._affinity_key_from_obj(obj)
+            pr = self._pick(affinity_key=pkey, pool=self.prefill_replicas,
+                            ring=self.prefill_ring)
+            pf_info = dict(self._pick_info)
+            if pr is not None:
+                self.tracer.emit("pick", rid, replica=pr.url,
+                                 pool="prefill", **pf_info)
+        if pr is None:
+            return await self._forward(request, body, rid, akey, None)
+        # The handoff pull slot is outstanding on this prefill replica for
+        # the request's lifetime — without the count the prefill pool's
+        # bounded-load overflow could never trigger (every prefill Replica
+        # would read inflight 0 forever) and a hot prefix would pin 100%
+        # of handoffs to one replica, each holding a bounded pull slot,
+        # while the rest of the pool idled. The request span over-estimates
+        # the pull window (decode rides along), which only makes spillover
+        # MORE eager under pile-up — the safe direction.
+        pr.inflight += 1
+        try:
+            return await self._forward(request, body, rid, akey, pr.url)
+        finally:
+            pr.inflight -= 1
+
+    async def _forward(self, request: web.Request, body: bytes, rid: str,
+                       akey: Optional[bytes],
+                       prefill_hdr: Optional[str]) -> web.StreamResponse:
+        """The failover forwarding loop of :meth:`proxy`, split out so the
+        prefill-slot accounting brackets it in one try/finally whatever
+        path it returns through."""
         tried: set[str] = set()
         last_err: Optional[Exception] = None
         connect_failed = False
@@ -729,10 +864,15 @@ class Router:
                     fwd_headers = {
                         k: v for k, v in request.headers.items()
                         if k.lower() not in HOP_HEADERS
-                        and k.lower() != REQUEST_ID_HEADER}
+                        and k.lower() not in (REQUEST_ID_HEADER,
+                                              PREFILL_URL_HEADER)}
                     # The replica adopts this as its engine request id, so
                     # its lifecycle trace correlates with the router spans.
                     fwd_headers[REQUEST_ID_HEADER] = rid
+                    if prefill_hdr is not None:
+                        # Router-owned (client values stripped above): the
+                        # decode replica pulls prefilled KV from here.
+                        fwd_headers[PREFILL_URL_HEADER] = prefill_hdr
                     t_attempt = time.monotonic()
                     upstream_cm = self._session.request(
                         request.method, f"{replica.url}{request.path_qs}",
@@ -866,7 +1006,17 @@ def main(argv: Optional[list[str]] = None) -> None:
 
     p = argparse.ArgumentParser()
     p.add_argument("--replicas", required=True,
-                   help="comma-separated replica base URLs")
+                   help="comma-separated replica base URLs (the client-"
+                   "facing pool: role 'both', or 'decode' when "
+                   "--prefill-replicas names a prefill pool)")
+    p.add_argument("--prefill-replicas", default=None,
+                   help="disaggregated prefill/decode: comma-separated "
+                   "base URLs of the PREFILL pool (replicas started with "
+                   "--role prefill). Completions are proxied to the main "
+                   "pool with an x-kgct-prefill-url header naming the "
+                   "prefix-affine prefill replica to pull KV from; absent "
+                   "or unhealthy prefill replicas degrade to colocated "
+                   "local prefill")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--routing-policy", default="least-inflight",
@@ -889,7 +1039,9 @@ def main(argv: Optional[list[str]] = None) -> None:
     router = Router(args.replicas.split(","),
                     routing_policy=args.routing_policy,
                     affinity_prefix_len=args.affinity_prefix_len,
-                    balance_factor=args.balance_factor)
+                    balance_factor=args.balance_factor,
+                    prefill_urls=(args.prefill_replicas.split(",")
+                                  if args.prefill_replicas else None))
     web.run_app(router.build_app(), host=args.host, port=args.port)
 
 

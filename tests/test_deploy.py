@@ -557,3 +557,139 @@ def test_model_url_validation():
     assert render_values(values("Qwen/Qwen3-0.6B"))
     # absolute path (pre-staged checkpoint) passes through untouched
     assert render_values(values("/models/llama-3-8b"))
+
+
+def _disagg_values(**spec_extra):
+    spec = {"name": "m", "modelURL": "tinyllama-1.1b",
+            "prefillReplicas": 2, "decodeReplicas": 3}
+    spec.update(spec_extra)
+    return {"servingEngineSpec": {"modelSpec": [spec]}}
+
+
+def test_disagg_renders_role_split_statefulsets():
+    """prefillReplicas/decodeReplicas -> one StatefulSet + headless Service
+    per phase pool, pods started with --role, and the router wired with
+    the decode pool as --replicas plus the prefill pool as
+    --prefill-replicas (golden pins of the disaggregated topology)."""
+    ms = render_values(_disagg_values())
+    _validate(ms)
+    for role, count in (("prefill", 2), ("decode", 3)):
+        sts = ms[f"m-{role}-engine-statefulset.yaml"]
+        assert sts["kind"] == "StatefulSet"
+        assert sts["spec"]["replicas"] == count
+        assert sts["spec"]["serviceName"] == f"kgct-m-{role}-engine-hl"
+        args = sts["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert args[args.index("--role") + 1] == role
+        if role == "decode":
+            # KV-pull allowlist: decode pods may only fetch handoffs from
+            # their spec's prefill pods (SSRF guard for per-pod DNS).
+            assert args[args.index("--prefill-pool") + 1] == ",".join(
+                f"http://kgct-m-prefill-engine-{i}"
+                f".kgct-m-prefill-engine-hl:8000" for i in range(2))
+        else:
+            assert "--prefill-pool" not in args
+        hl = ms[f"m-{role}-engine-headless-svc.yaml"]
+        assert hl["spec"]["clusterIP"] == "None"
+        assert hl["spec"]["publishNotReadyAddresses"] is True
+    # No plain Deployment/Service for a disaggregated spec: the router
+    # addresses pods directly in both pools.
+    assert "m-engine-deployment.yaml" not in ms
+    rargs = ms["router-deployment.yaml"]["spec"]["template"]["spec"][
+        "containers"][0]["args"]
+    assert rargs[rargs.index("--replicas") + 1] == ",".join(
+        f"http://kgct-m-decode-engine-{i}.kgct-m-decode-engine-hl:8000"
+        for i in range(3))
+    assert rargs[rargs.index("--prefill-replicas") + 1] == ",".join(
+        f"http://kgct-m-prefill-engine-{i}.kgct-m-prefill-engine-hl:8000"
+        for i in range(2))
+
+
+def test_disagg_validation():
+    import pytest
+
+    # One-sided pools cannot be routed.
+    with pytest.raises(ValueError, match="set together"):
+        render_values({"servingEngineSpec": {"modelSpec": [
+            {"name": "m", "modelURL": "tinyllama-1.1b",
+             "prefillReplicas": 2}]}})
+    with pytest.raises(ValueError, match=">= 1"):
+        render_values(_disagg_values(prefillReplicas=0))
+    # Disaggregation does not compose with multihost (SPMD lockstep).
+    with pytest.raises(ValueError, match="multihost"):
+        render_values(_disagg_values(
+            vllmConfig={"pipelineParallelSize": 2}))
+    # ...nor with a multi-modelSpec stack: the one router has ONE prefill
+    # ring, while each decode pod's --prefill-pool allowlist covers only
+    # its own spec — cross-spec picks would silently degrade to local
+    # recompute on every affected prefix.
+    vals = _disagg_values()
+    vals["servingEngineSpec"]["modelSpec"].append(
+        {"name": "other", "modelURL": "tinyllama-1.1b", "replicaCount": 1})
+    with pytest.raises(ValueError, match="multi-modelSpec"):
+        render_values(vals)
+
+
+def test_default_render_has_no_role_flag():
+    """role: both is the engine default and renders NO flag — a
+    non-disaggregated spec's manifests are byte-identical to before."""
+    ms = render_values({"servingEngineSpec": {"modelSpec": [
+        {"name": "m", "modelURL": "tinyllama-1.1b"}]}})
+    args = ms["m-engine-deployment.yaml"]["spec"]["template"]["spec"][
+        "containers"][0]["args"]
+    assert "--role" not in args
+    assert not any(f.endswith("hpa.yaml") for f in ms)
+
+
+def test_autoscaling_renders_hpa_golden():
+    """autoscaling.enabled -> an autoscaling/v2 HPA off the landed
+    autoscaler signals: queue-wait p90 + shed rate as Pods metrics, the
+    SLO attainment gauge documented as the (inverse) guardrail, and
+    scale-down stabilized against ring-remap flapping."""
+    ms = render_values({"servingEngineSpec": {"modelSpec": [
+        {"name": "m", "modelURL": "tinyllama-1.1b", "replicaCount": 2,
+         "autoscaling": {"enabled": True, "minReplicas": 2,
+                         "maxReplicas": 9,
+                         "targetQueueWaitSeconds": 0.25}}]}})
+    _validate(ms)
+    hpa = ms["m-engine-hpa.yaml"]
+    assert hpa["apiVersion"] == "autoscaling/v2"
+    assert hpa["kind"] == "HorizontalPodAutoscaler"
+    spec = hpa["spec"]
+    assert spec["scaleTargetRef"] == {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "name": "kgct-m-engine"}
+    assert (spec["minReplicas"], spec["maxReplicas"]) == (2, 9)
+    metrics = {m["pods"]["metric"]["name"]:
+               m["pods"]["target"]["averageValue"]
+               for m in spec["metrics"]}
+    assert metrics == {"kgct_queue_wait_seconds_p90": "250m",
+                       "kgct_requests_shed_per_second": "100m"}
+    assert spec["behavior"]["scaleDown"]["stabilizationWindowSeconds"] == 300
+    ann = hpa["metadata"]["annotations"]
+    assert "kgct_slo_ttft_attainment_ratio" in ann["kgct.io/slo-guardrail"]
+    assert "histogram_quantile" in ann["kgct.io/adapter-rule-queue-wait"]
+    # maxReplicas defaults from replicaCount when omitted.
+    ms2 = render_values({"servingEngineSpec": {"modelSpec": [
+        {"name": "m", "modelURL": "tinyllama-1.1b", "replicaCount": 3,
+         "autoscaling": {"enabled": True}}]}})
+    assert ms2["m-engine-hpa.yaml"]["spec"]["maxReplicas"] == 6
+
+
+def test_autoscaling_rejected_for_static_pod_list_topologies():
+    """HPA + a STATIC per-pod router replica list is a contradiction: the
+    scaler would add pods the ring never owns. Fails the RENDER with
+    guidance for prefix-affinity, disaggregated, and multihost specs."""
+    import pytest
+
+    with pytest.raises(ValueError, match="Deployment topology"):
+        render_values({"servingEngineSpec": {"modelSpec": [
+            {"name": "m", "modelURL": "tinyllama-1.1b",
+             "vllmConfig": {"routingPolicy": "prefix-affinity"},
+             "autoscaling": {"enabled": True}}]}})
+    with pytest.raises(ValueError, match="Deployment topology"):
+        render_values(_disagg_values(autoscaling={"enabled": True}))
+    with pytest.raises(ValueError, match="multihost"):
+        render_values({"servingEngineSpec": {"modelSpec": [
+            {"name": "m", "modelURL": "tinyllama-1.1b",
+             "vllmConfig": {"pipelineParallelSize": 2},
+             "autoscaling": {"enabled": True}}]}})

@@ -684,3 +684,67 @@ class TestFramework:
         findings = run_lint([f], root=tmp_path)
         assert [x.rule for x in findings] == ["KGCT006", "KGCT008"]
         assert findings[0].format().startswith("two.py:")
+
+
+class TestKVBoundary:  # KGCT013
+    def test_np_asarray_of_kv_pool_fires(self):
+        found = lint("""
+            import numpy as np
+
+            class Engine:
+                def leak(self, pages):
+                    return np.asarray(self.kv_cache.k[:, pages])
+        """, "KGCT013", relpath="engine/engine.py")
+        assert len(found) == 1 and "sanctioned" in found[0].message
+
+    def test_device_get_of_kv_fires_in_serving(self):
+        found = lint("""
+            import jax
+
+            def ship(kv):
+                return jax.device_get(kv.k)
+        """, "KGCT013", relpath="serving/api_server.py")
+        assert len(found) == 1
+
+    def test_kv_cache_module_is_the_sanctioned_seam(self):
+        """The seam's own gather (np.asarray of the fetched KV inside
+        kv_cache.py) is exempt — it IS the sanctioned path."""
+        assert lint("""
+            import numpy as np
+
+            class KVPageIO:
+                def export_pages(self, pages):
+                    k_g, v_g = self._gather_fn(self.kv.k, self.kv.v, pages)
+                    return np.asarray(k_g), np.asarray(v_g)
+        """, "KGCT013", relpath="engine/kv_cache.py") == []
+
+    def test_non_kv_fetches_stay_silent(self):
+        assert lint("""
+            import numpy as np
+
+            def fine(batch, next_tokens, seq):
+                a = np.asarray(next_tokens)
+                b = np.asarray(batch.tokens)
+                c = np.asarray(seq.pages, np.int64)
+                return a, b, c
+        """, "KGCT013", relpath="engine/engine.py") == []
+
+
+class TestSwapOrderExportCoverage:  # KGCT010 extension
+    def test_free_before_export_gather_fires(self):
+        found = lint("""
+            class Engine:
+                def export_held(self, seq):
+                    self.scheduler.allocator.free(seq.pages)
+                    return self.kv_io.export_pages(seq.pages)
+        """, "KGCT010", relpath="engine/engine.py")
+        assert len(found) == 1 and "before" in found[0].message
+
+    def test_gather_then_free_is_clean(self):
+        assert lint("""
+            class Engine:
+                def export_held(self, seq):
+                    k, v = self.kv_io.export_pages(seq.pages)
+                    self.scheduler.allocator.free(seq.pages)
+                    return k, v
+        """, "KGCT010", relpath="engine/engine.py") == []

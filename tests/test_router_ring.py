@@ -443,8 +443,10 @@ class TestRouterMetricsAggregation:
                 _assert_valid_exposition(text)
 
                 def val(name, url):
+                    # Per-replica gauges may carry a role label after the
+                    # replica label (disaggregated pools).
                     [line] = [l for l in text.splitlines()
-                              if l.startswith(f'{name}{{replica="{url}"}}')]
+                              if l.startswith(f'{name}{{replica="{url}"')]
                     return float(line.rpartition(" ")[2])
 
                 assert val("kgct_router_replica_prefix_cache_hit_ratio",
@@ -453,6 +455,11 @@ class TestRouterMetricsAggregation:
                            b_url) == 0.0
                 assert val("kgct_router_replica_num_swapped", a_url) == 2.0
                 assert val("kgct_router_replica_num_swapped", b_url) == 0.0
+                # Pool-role label: a non-disaggregated router labels every
+                # replica gauge role="both" (the pre-disaggregation
+                # behavior, one spelling fleet-wide).
+                assert (f'kgct_router_replica_healthy{{replica="{a_url}",'
+                        'role="both"} 1') in text
                 # Affinity accounting: present and zero-safe even on the
                 # default policy with zero affinity-keyed traffic.
                 assert "kgct_router_affinity_hit_ratio 0.0" in text
@@ -670,3 +677,216 @@ class TestRouterBenchPhase:
         assert all(p["requests"] > 0 for p in li["per_replica"])
         assert out["warm_ttft_ratio"] is not None
         assert out["warm_ttft_ratio"] < 1.5   # loose: not a perf pin
+
+
+class TestDisaggRouting:
+    """Disaggregated prefill/decode at the ROUTER layer (engine-free):
+    prefill-pool picks flow through the one _pick seam on a dedicated
+    ring, the forwarded header names the picked prefill replica (and
+    client-supplied values are stripped), and one scrape separates the
+    pools by role."""
+
+    PF_URLS = [f"http://prefill-{i}:8000" for i in range(2)]
+
+    def test_prefill_pick_is_prefix_affine_even_under_least_inflight(self):
+        router = Router(list(URLS), routing_policy="least-inflight",
+                        prefill_urls=list(self.PF_URLS))
+        key = b"text:some prompt prefix"
+        owner = router.prefill_ring.owner(key)
+        for _ in range(5):
+            picked = router._pick(affinity_key=key,
+                                  pool=router.prefill_replicas,
+                                  ring=router.prefill_ring)
+            assert picked.url == owner
+        # Prefill-pool picks never pollute the MAIN pool's affinity
+        # accounting.
+        assert router.affinity_requests_total == 0
+        # Dead owner: keys remap to the ring successor, deterministic.
+        dead = next(r for r in router.prefill_replicas if r.url == owner)
+        dead.healthy = False
+        picked = router._pick(affinity_key=key,
+                              pool=router.prefill_replicas,
+                              ring=router.prefill_ring)
+        assert picked.url == next(u for u in router.prefill_ring.walk(key)
+                                  if u != owner)
+        assert router.ring_remaps_total == 0   # main-pool counter untouched
+
+    def test_prefill_pool_bounded_load_spills_off_a_hot_owner(self):
+        """A prefill replica holding outstanding pull slots overflows the
+        CHWBL bound to the ring successor — live only because proxy()
+        accounts the pull slot on the picked replica (at permanent
+        inflight 0 the bound is never exceeded and a hot prefix would pin
+        100% of handoffs to one replica)."""
+        router = Router(list(URLS), routing_policy="least-inflight",
+                        prefill_urls=list(self.PF_URLS))
+        key = b"text:some prompt prefix"
+        owner_url = router.prefill_ring.owner(key)
+        owner = next(r for r in router.prefill_replicas
+                     if r.url == owner_url)
+        owner.inflight = 10            # outstanding handoff pull slots
+        picked = router._pick(affinity_key=key,
+                              pool=router.prefill_replicas,
+                              ring=router.prefill_ring)
+        assert picked.url != owner_url
+        assert router._pick_info["pick"] == "affinity_overflow"
+
+    def test_proxy_accounts_the_prefill_pull_slot(self):
+        """proxy() holds one inflight slot on the picked prefill replica
+        for the request's lifetime and always drains it."""
+        async def scenario():
+            pf_runner, pf_url, _ = await _recording_replica()
+            dc_runner, dc_url, _ = await _recording_replica()
+            router = Router([dc_url], health_interval_s=9999,
+                            prefill_urls=[pf_url])
+            client = await _start_router(router)
+            pf = router.prefill_replicas[0]
+            seen = []
+            orig = router._session.request
+
+            def spy(method, url, **kw):
+                seen.append(pf.inflight)
+                return orig(method, url, **kw)
+
+            router._session.request = spy
+            try:
+                r = await client.post("/v1/completions",
+                                      json={"prompt": "x"})
+                assert r.status == 200
+                assert seen[-1] == 1   # held while forwarding downstream
+                await r.read()         # drain the relay to its finally
+                await asyncio.sleep(0.05)
+                assert pf.inflight == 0            # drained at completion
+            finally:
+                await client.close()
+                await pf_runner.cleanup()
+                await dc_runner.cleanup()
+        asyncio.run(scenario())
+
+    def test_header_forwarded_and_client_value_stripped(self):
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            PREFILL_URL_HEADER)
+
+        async def scenario():
+            pf_runner, pf_url, _ = await _recording_replica()
+            dc_runner, dc_url, dc_served = await _recording_replica()
+
+            # Capture the headers the decode replica actually receives.
+            router = Router([dc_url], health_interval_s=9999,
+                            prefill_urls=[pf_url])
+            client = await _start_router(router)
+            seen = []
+            orig = router._session.request
+
+            def spy(method, url, **kw):
+                seen.append(kw.get("headers") or {})
+                return orig(method, url, **kw)
+
+            router._session.request = spy
+            try:
+                r = await client.post(
+                    "/v1/completions", json={"prompt": "x"},
+                    headers={PREFILL_URL_HEADER: "http://evil:1"})
+                assert r.status == 200
+                fwd = seen[-1]
+                assert fwd[PREFILL_URL_HEADER] == pf_url
+                # /v1/models (no body/prompt) never carries the header.
+                r = await client.get("/v1/models")
+                assert PREFILL_URL_HEADER not in (seen[-1] or {})
+                # The pick span carries the pool attribution.
+                picks = [e for e in router.tracer.events()
+                         if e.kind == "pick"
+                         and e.args.get("pool") == "prefill"]
+                assert picks and picks[0].args["replica"] == pf_url
+            finally:
+                await client.close()
+                await pf_runner.cleanup()
+                await dc_runner.cleanup()
+        asyncio.run(scenario())
+
+    def test_metrics_and_health_separate_pools_by_role(self):
+        async def scenario():
+            pf_runner, pf_url, _ = await _recording_replica()
+            dc_runner, dc_url, _ = await _recording_replica()
+            router = Router([dc_url], health_interval_s=9999,
+                            prefill_urls=[pf_url])
+            client = await _start_router(router)
+            try:
+                r = await client.get("/metrics")
+                text = await r.text()
+                _assert_valid_exposition(text)
+                assert (f'kgct_router_replica_healthy{{replica="{dc_url}",'
+                        'role="decode"} 1') in text
+                assert (f'kgct_router_replica_healthy{{replica="{pf_url}",'
+                        'role="prefill"} 1') in text
+                # Locality gauges cover BOTH pools, zero-safe.
+                assert (f'kgct_router_replica_prefix_cache_hit_ratio'
+                        f'{{replica="{pf_url}",role="prefill"}} 0.0') \
+                    in text
+                r = await client.get("/health")
+                body = await r.json()
+                assert body["replicas"][pf_url]["role"] == "prefill"
+                assert body["replicas"][dc_url]["role"] == "decode"
+            finally:
+                await client.close()
+                await pf_runner.cleanup()
+                await dc_runner.cleanup()
+        asyncio.run(scenario())
+
+    def test_no_healthy_prefill_pool_degrades_to_no_header(self):
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            PREFILL_URL_HEADER)
+
+        async def scenario():
+            dc_runner, dc_url, dc_served = await _recording_replica()
+            # Nothing listens on the prefill URL: the startup probe
+            # benches it; completions must still flow, headerless.
+            router = Router([dc_url], health_interval_s=9999,
+                            prefill_urls=["http://127.0.0.1:1"])
+            client = await _start_router(router)
+            seen = []
+            orig = router._session.request
+
+            def spy(method, url, **kw):
+                seen.append(kw.get("headers") or {})
+                return orig(method, url, **kw)
+
+            router._session.request = spy
+            try:
+                r = await client.post("/v1/completions",
+                                      json={"prompt": "x"})
+                assert r.status == 200
+                assert PREFILL_URL_HEADER not in seen[-1]
+            finally:
+                await client.close()
+                await dc_runner.cleanup()
+        asyncio.run(scenario())
+
+    def test_multi_sequence_requests_skip_the_prefill_pick(self):
+        """n/best_of > 1 requests fan out through the replica's _run_n
+        BEFORE its handoff block — a prefill pick would hold a phantom
+        pull slot forever. Only positively multi-sequence bodies skip;
+        everything else (absent, n=1, unparseable) stays eligible."""
+        def ok(body):
+            return Router._handoff_eligible(Router._parse_json_dict(body))
+        assert not ok(b'{"prompt": "x", "n": 2}')
+        assert not ok(b'{"prompt": "x", "best_of": 3}')
+        assert ok(b'{"prompt": "x"}')
+        assert ok(b'{"prompt": "x", "n": 1}')
+        assert ok(b'{"prompt": "x", "n": 1, "best_of": 1}')
+        assert ok(b'{"prompt": "x", "n": "zzz"}')   # replica's 400 to give
+        assert ok(b'not json at all')
+        assert ok(b'[1, 2, 3]')
+
+    def test_flight_snapshot_covers_both_pools(self):
+        """Flight-recorder fleet snapshots report inflight/health for the
+        prefill pool too, not just the main pool."""
+        router = Router(list(URLS), routing_policy="least-inflight",
+                        prefill_urls=list(self.PF_URLS))
+        router.prefill_replicas[0].inflight = 3
+        router.prefill_replicas[1].healthy = False
+        snap = router._flight_snapshot()
+        for url in (*URLS, *self.PF_URLS):
+            assert url in snap["inflight"]
+        assert snap["inflight"][self.PF_URLS[0]] == 3
+        assert self.PF_URLS[0] in snap["healthy"]
+        assert self.PF_URLS[1] not in snap["healthy"]

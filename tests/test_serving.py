@@ -919,3 +919,201 @@ class TestMultipleCompletions:
                 "prompt": [2, 8], "max_tokens": 2, "n": 0})
             assert r.status == 400
         loop.run_until_complete(go())
+
+
+class TestKVHandoffOnWarmServer:
+    """Disaggregated-serving paths that need only the module's warm
+    role="both" server: the export endpoint, and the decode-side fallback
+    to local recompute (chaos site kv_handoff_fail + dead prefill URL),
+    with the flight recorder capturing the fallback trigger."""
+
+    def test_kv_handoff_export_endpoint(self, api_client):
+        from kubernetes_gpu_cluster_tpu.serving.handoff import decode_handoff
+
+        loop, client = api_client
+
+        async def go():
+            r = await client.post("/internal/kv_handoff", json={
+                "prompt_token_ids": list(range(2, 40)),
+                "temperature": 0.0})
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/octet-stream"
+            state = decode_handoff(await r.read())
+            assert state["model"] == "debug-tiny"
+            assert len(state["output_token_ids"]) == 1   # max_tokens clamp
+            assert state["k"].shape[1] > 0
+            # Malformed bodies are loud 400s, not engine crashes.
+            r = await client.post("/internal/kv_handoff",
+                                  json={"prompt_token_ids": []})
+            assert r.status == 400
+            r = await client.post("/internal/kv_handoff",
+                                  json={"prompt_token_ids": ["x"]})
+            assert r.status == 400
+        loop.run_until_complete(go())
+
+    def test_export_failure_counts_outcome_error(self, api_client):
+        """An export that dies AFTER admission (engine-side rejection —
+        here an out-of-vocab logit_bias id surfacing through the worker)
+        must move kgct_disagg_handoffs_total{side="export",
+        outcome="error"}: an operator watching a failing prefill pool
+        reads the counter, while the 400 itself only reaches the one
+        client (the decode side can only ever count its own fallbacks)."""
+        loop, client = api_client
+        server = _SERVER["api"]
+
+        async def go():
+            before = server.disagg.handoffs.get(("export", "error"), 0)
+            r = await client.post("/internal/kv_handoff", json={
+                "prompt_token_ids": list(range(2, 10)),
+                "temperature": 0.0,
+                "logit_bias": {"999999": 5}})
+            assert r.status == 400
+            assert server.disagg.handoffs[("export", "error")] == before + 1
+        loop.run_until_complete(go())
+
+    def test_handoff_pull_failure_falls_back_to_local_recompute(
+            self, api_client):
+        """A completion carrying a prefill-url header whose pull fails —
+        chaos-injected (kv_handoff_fail) or a dead upstream — serves the
+        SAME output as a plain request (local recompute), 200, with the
+        fallback trigger captured in trace ring + flight recorder and the
+        fallback counter on /metrics."""
+        from kubernetes_gpu_cluster_tpu.resilience.faults import (
+            configure_faults)
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            PREFILL_URL_HEADER)
+
+        loop, client = api_client
+        body = {"prompt": "fall back please", "max_tokens": 4,
+                "temperature": 0.0}
+
+        async def go():
+            r = await client.post("/v1/completions", json=body)
+            ref = (await r.json())["choices"][0]["text"]
+
+            configure_faults("kv_handoff_fail")
+            try:
+                r = await client.post(
+                    "/v1/completions", json=body,
+                    headers={PREFILL_URL_HEADER: "http://127.0.0.1:9"})
+                assert r.status == 200
+                assert (await r.json())["choices"][0]["text"] == ref
+            finally:
+                configure_faults(None)
+            # Unarmed but dead upstream: the bounded fetch fails, same
+            # graceful fallback.
+            r = await client.post(
+                "/v1/completions", json=body,
+                headers={PREFILL_URL_HEADER: "http://127.0.0.1:9"})
+            assert r.status == 200
+            assert (await r.json())["choices"][0]["text"] == ref
+
+            flight = _SERVER["api"].engine.engine.obs.flight.export()
+            falls = [e for e in flight["events"]
+                     if e["kind"] == "handoff"
+                     and e.get("outcome") == "fallback"]
+            assert len(falls) >= 2       # chaos trigger + dead upstream
+            assert any("kv_handoff_fail" in (e.get("error") or "")
+                       for e in falls)
+            r = await client.get("/metrics")
+            text = await r.text()
+            _assert_valid_exposition(text)
+            assert ('kgct_disagg_handoffs_total{side="import",'
+                    'outcome="fallback"} 2') in text
+            assert 'kgct_engine_role{role="both"} 1' in text
+        loop.run_until_complete(go())
+
+    def test_prefill_pool_allowlist_gates_the_pull(self, api_client):
+        """With --prefill-pool set, a header naming an out-of-pool URL is
+        NEVER fetched (SSRF guard for direct-to-pod traffic) — the request
+        serves by local recompute with the allowlist rejection, not a
+        connect error, as the fallback trigger; an in-pool URL still
+        reaches the fetch path."""
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            PREFILL_URL_HEADER)
+
+        loop, client = api_client
+        server = _SERVER["api"]
+        body = {"prompt": "allowlist me", "max_tokens": 4,
+                "temperature": 0.0}
+        assert server.prefill_pool is None   # warm server: trust-the-net
+        server.prefill_pool = frozenset({"http://127.0.0.1:9"})
+        try:
+
+            async def go():
+                r = await client.post("/v1/completions", json=body)
+                ref = (await r.json())["choices"][0]["text"]
+                r = await client.post(
+                    "/v1/completions", json=body,
+                    headers={PREFILL_URL_HEADER: "http://evil.example:80"})
+                assert r.status == 200
+                assert (await r.json())["choices"][0]["text"] == ref
+                flight = server.engine.engine.obs.flight.export()
+                rejects = [e for e in flight["events"]
+                           if e["kind"] == "handoff"
+                           and "not in --prefill-pool"
+                           in (e.get("error") or "")]
+                assert len(rejects) == 1
+                # In-pool URL (trailing slash tolerated) passes the gate:
+                # the pull itself then fails on the dead upstream — a
+                # CONNECT error, not the allowlist.
+                r = await client.post(
+                    "/v1/completions", json=body,
+                    headers={PREFILL_URL_HEADER: "http://127.0.0.1:9/"})
+                assert r.status == 200
+                assert (await r.json())["choices"][0]["text"] == ref
+                flight = server.engine.engine.obs.flight.export()
+                rejects = [e for e in flight["events"]
+                           if e["kind"] == "handoff"
+                           and "not in --prefill-pool"
+                           in (e.get("error") or "")]
+                assert len(rejects) == 1   # unchanged
+            loop.run_until_complete(go())
+        finally:
+            server.prefill_pool = None
+
+    def test_engine_side_import_fallback_reports_to_metrics(self, api_client):
+        """An ENGINE-side import failure (worker thread, after the pull was
+        already counted ok) reports through the on_import_fallback hook the
+        server installs — without it /metrics reads 100% successful imports
+        on a replica that recomputes everything."""
+        loop, client = api_client
+        server = _SERVER["api"]
+        assert server.engine.on_import_fallback is not None
+        before = server.disagg.handoffs.get(("import", "fallback"), 0)
+        server.engine.on_import_fallback()
+        assert server.disagg.handoffs[("import", "fallback")] == before + 1
+
+
+class TestWorkerOpShutdownGuard:
+    """An op enqueued after the worker thread's final wakeup can never
+    drain — run_in_worker must fail the awaiter NOW (a kv_handoff export
+    would otherwise hang until the client's own timeout) and
+    post_to_worker must drop loudly instead of enqueueing into the void.
+    Engine-free: the guard reads only the op-queue fields."""
+
+    def _dead_engine(self):
+        import threading
+
+        from kubernetes_gpu_cluster_tpu.serving.async_engine import (
+            AsyncLLMEngine)
+        eng = AsyncLLMEngine.__new__(AsyncLLMEngine)
+        eng._cv = threading.Condition()
+        eng._ops = []
+        eng._shutdown = True
+        eng._thread = threading.Thread()   # never started
+        return eng
+
+    def test_run_in_worker_fails_fast_after_shutdown(self):
+        eng = self._dead_engine()
+
+        async def go():
+            with pytest.raises(RuntimeError, match="shut down"):
+                await eng.run_in_worker(lambda e: 1)
+        asyncio.run(go())
+        assert eng._ops == []            # never enqueued
+
+    def test_post_to_worker_drops_after_shutdown(self):
+        eng = self._dead_engine()
+        eng.post_to_worker(lambda e: 1)
+        assert eng._ops == []
