@@ -155,6 +155,20 @@ DISAGG_DECODE_ROUNDS = int(os.environ.get("KGCT_BENCH_DISAGG_ROUNDS", 2))
 DISAGG_PREFILLS = int(os.environ.get("KGCT_BENCH_DISAGG_PREFILLS", 6))
 DISAGG_MAX_NEW = int(os.environ.get("KGCT_BENCH_DISAGG_MAX_NEW", 16))
 
+# Drain phase (session survivability A/B): an oversubscribed streaming
+# session workload over 2 replicas behind the router; one replica begins a
+# SIGTERM drain mid-stream, once with live KV migration (drain time is
+# transfer-bound: push each running sequence to the peer, the router
+# splices the resumed streams) and once with migration disabled via the
+# migrate_fail chaos site (the pre-migration wait-it-out path: drain time
+# is bound by the longest remaining decode). Headline
+# ``drain_migrate_over_wait_seconds`` = migrate-arm drain seconds /
+# wait-arm drain seconds. Always debug-tiny engines. KGCT_BENCH_DRAIN=0
+# skips.
+DRAIN_BENCH = os.environ.get("KGCT_BENCH_DRAIN", "1") != "0"
+DRAIN_SESSIONS = int(os.environ.get("KGCT_BENCH_DRAIN_SESSIONS", 6))
+DRAIN_MAX_NEW = int(os.environ.get("KGCT_BENCH_DRAIN_MAX_NEW", 48))
+
 # The stdout contract bench.py guarantees (also the --help epilog, and what
 # tests/test_bench_contract.py pins): everything before the last line is
 # free-form noise; the LAST non-empty stdout line is the result.
@@ -1325,6 +1339,161 @@ def _measure_disagg() -> dict:
     return out
 
 
+def _measure_drain() -> dict:
+    """KGCT_BENCH_DRAIN phase: drain-with-migration vs wait-it-out A/B.
+
+    Both arms run the same oversubscribed streaming session workload (more
+    concurrent sessions than one replica's batch seats) over 2 role="both"
+    replicas behind the real router, then begin a SIGTERM drain on one
+    replica while every session is mid-stream:
+
+    - arm "migrate": the draining replica live-migrates each running
+      sequence's committed KV to the router-named peer and severs the
+      relay; the router splices the resumed streams (parked-KV import on
+      the peer), so the drain completes as soon as the pushes do —
+      TRANSFER-bound;
+    - arm "wait": the ``migrate_fail`` chaos site fails every export, so
+      each sequence degrades to the pre-migration wait-it-out path and the
+      drain completes only when the longest in-flight decode does —
+      DECODE-bound.
+
+    Reported per arm: drain wall seconds (begin_drain -> drain task done)
+    and the count of client streams that still completed end-to-end (the
+    survivability contract: BOTH arms must deliver every stream; only the
+    drain time differs). Headline ``drain_migrate_over_wait_seconds`` =
+    migrate drain seconds / wait drain seconds."""
+    import asyncio
+
+    import aiohttp
+    from aiohttp import web as aioweb
+
+    from kubernetes_gpu_cluster_tpu.resilience.faults import configure_faults
+    from kubernetes_gpu_cluster_tpu.serving.api_server import build_server
+    from kubernetes_gpu_cluster_tpu.serving.router import Router
+
+    on_tpu = jax.default_backend() == "tpu"
+    page = PAGE if PAGE is not None else (128 if on_tpu else 16)
+    prompt_len = 2 * page
+    vocab_cap = 200
+    seats = max(2, (DRAIN_SESSIONS + 1) // 2)   # per-replica seats < sessions:
+                                                # the post-migration survivor
+                                                # is oversubscribed and queues
+    ladder = (32, 64, 128, 256, 512, 1024)
+    top = next((b for b in ladder if b >= prompt_len), prompt_len)
+    buckets = tuple(b for b in ladder if b < prompt_len) + (top,)
+    pages_per_seq = cdiv(prompt_len + DRAIN_MAX_NEW + 4, page) + 1
+
+    def engine_config():
+        return EngineConfig(
+            model=get_model_config("debug-tiny"),
+            cache=CacheConfig(page_size=page,
+                              num_pages=2 * DRAIN_SESSIONS * pages_per_seq
+                              + 1),
+            scheduler=SchedulerConfig(
+                max_num_seqs=seats, max_prefill_tokens=top,
+                decode_buckets=(1, 2, 4, 8), prefill_buckets=buckets,
+                decode_window=4, mixed_batch_enabled=False))
+
+    def prompt_of(seed: int) -> list:
+        return np.random.default_rng(seed).integers(
+            1, vocab_cap, prompt_len).tolist()
+
+    async def run_arm(migrate: bool) -> dict:
+        runners, servers = [], []
+
+        async def serve():
+            srv = build_server(engine_config(), None, "debug-tiny")
+            runner = aioweb.AppRunner(srv.build_app())
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runners.append(runner)
+            servers.append(srv)
+            return f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+        urls = [await serve(), await serve()]
+        router = Router(urls, health_interval_s=9999)
+        rrunner = aioweb.AppRunner(router.build_app())
+        await rrunner.setup()
+        rsite = aioweb.TCPSite(rrunner, "127.0.0.1", 0)
+        await rsite.start()
+        router_url = f"http://127.0.0.1:{rrunner.addresses[0][1]}"
+        out: dict = {"arm": "migrate" if migrate else "wait"}
+        try:
+            async with aiohttp.ClientSession() as sess:
+                # Warmup: compile the prefill bucket + decode windows on
+                # both replicas (direct), then the migration seam's
+                # import path stays cold — its cost IS part of the A/B.
+                for i, u in enumerate(urls):
+                    async with sess.post(
+                            f"{u}/v1/completions",
+                            json={"prompt": prompt_of(9_000 + i),
+                                  "max_tokens": 8,
+                                  "temperature": 0.0}) as resp:
+                        assert resp.status == 200, await resp.text()
+                        await resp.read()
+
+                started = [asyncio.Event() for _ in range(DRAIN_SESSIONS)]
+
+                async def session(s: int) -> bool:
+                    """One streamed completion; True iff the client saw a
+                    complete stream ([DONE], no error frame)."""
+                    saw_done, saw_error = False, False
+                    async with sess.post(
+                            f"{router_url}/v1/completions",
+                            json={"prompt": prompt_of(s),
+                                  "max_tokens": DRAIN_MAX_NEW,
+                                  "temperature": 0.0,
+                                  "stream": True}) as resp:
+                        assert resp.status == 200, await resp.text()
+                        async for line in resp.content:
+                            text = line.decode("utf-8", "replace").strip()
+                            if text.startswith("data:"):
+                                started[s].set()
+                                payload = text[5:].strip()
+                                if payload == "[DONE]":
+                                    saw_done = True
+                                elif '"error"' in payload:
+                                    saw_error = True
+                    return saw_done and not saw_error
+
+                tasks = [asyncio.create_task(session(s))
+                         for s in range(DRAIN_SESSIONS)]
+                await asyncio.gather(*(e.wait() for e in started))
+                if not migrate:
+                    configure_faults("migrate_fail")
+                t0 = time.perf_counter()
+                drain_task = servers[0].begin_drain()
+                assert drain_task is not None
+                await drain_task
+                out["drain_seconds"] = round(time.perf_counter() - t0, 3)
+                complete = await asyncio.gather(*tasks)
+                out["complete_streams"] = sum(complete)
+                out["sessions"] = DRAIN_SESSIONS
+                mig = servers[0].migration.migrations
+                out["migrations_push_ok"] = mig.get(("push", "ok"), 0)
+                out["migrations_push_fallback"] = mig.get(
+                    ("push", "fallback"), 0)
+                out["failovers"] = dict(router.failovers_total)
+        finally:
+            configure_faults(None)
+            await rrunner.cleanup()
+            for runner in runners:
+                await runner.cleanup()
+        return out
+
+    out: dict = {"sessions": DRAIN_SESSIONS, "max_new": DRAIN_MAX_NEW,
+                 "prompt_tokens": prompt_len, "seats_per_replica": seats}
+    for label, migrate in (("wait", False), ("migrate", True)):
+        out[label] = asyncio.run(run_arm(migrate))
+        gc.collect()
+    mig, wait = out["migrate"], out["wait"]
+    out["drain_migrate_over_wait_seconds"] = (
+        round(mig["drain_seconds"] / wait["drain_seconds"], 3)
+        if mig.get("drain_seconds") and wait.get("drain_seconds") else None)
+    return out
+
+
 # --------------------------------------------------------------------------
 # Per-config driver
 # --------------------------------------------------------------------------
@@ -1554,6 +1723,12 @@ def assemble_output(results: list[dict], backend: str) -> dict:
         # block in configs[-1].disagg).
         "disagg_tpot_over_colocated": (
             primary.get("disagg", {}).get("tpot_p95_ratio")),
+        # Drain phase headline: drain wall seconds with live KV migration
+        # as a fraction of the wait-it-out drain's, same oversubscribed
+        # streaming workload, every client stream delivered in both arms
+        # (full A/B block in configs[-1].drain).
+        "drain_migrate_over_wait_seconds": (
+            primary.get("drain", {}).get("drain_migrate_over_wait_seconds")),
         # SLO headline: fraction of the overload phase's admitted requests
         # whose TTFT met the admission budget — the attainment read
         # BENCH_r06 captures alongside raw TTFT (full block in
@@ -1629,6 +1804,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
             "scrape per arm, default on; 0=skip), "
             "KGCT_BENCH_DISAGG_SESSIONS, KGCT_BENCH_DISAGG_ROUNDS, "
             "KGCT_BENCH_DISAGG_PREFILLS, KGCT_BENCH_DISAGG_MAX_NEW, "
+            "KGCT_BENCH_DRAIN (1=session-survivability phase: "
+            "drain-with-live-KV-migration vs wait-it-out drain A/B on an "
+            "oversubscribed streaming workload through the router, "
+            "default on; 0=skip), KGCT_BENCH_DRAIN_SESSIONS, "
+            "KGCT_BENCH_DRAIN_MAX_NEW, "
             "KGCT_BENCH_PROMPT, KGCT_BENCH_PAGE, "
             "KGCT_CHIP_HBM_GBPS, KGCT_CHIP_TFLOPS_BF16. KGCT_BENCH_QUANT "
             "accepts int8 or int4 (the W4A16 dequant-fused path)."))
@@ -1643,6 +1823,7 @@ _DROPPABLE_HEADLINE = ("ttft_decomposition", "baseline_bar", "mixed_batch",
                        "swap_resume_over_recompute_ttft", "preemptions",
                        "router_affinity_warm_over_li_ttft",
                        "disagg_tpot_over_colocated",
+                       "drain_migrate_over_wait_seconds",
                        "slo_ttft_attainment_ratio",
                        "decode_window", "prefill_budget", "vs_baseline")
 
@@ -1776,6 +1957,11 @@ def main() -> None:
         # handoff vs colocated replicas (always debug-tiny engines; see
         # _measure_disagg).
         results[-1]["disagg"] = _measure_disagg()
+    if DRAIN_BENCH:
+        # Session-survivability phase: drain-with-migration vs wait-it-out
+        # on an oversubscribed streaming workload (always debug-tiny
+        # engines; see _measure_drain).
+        results[-1]["drain"] = _measure_drain()
     emit_result(assemble_output(results, backend))
 
 

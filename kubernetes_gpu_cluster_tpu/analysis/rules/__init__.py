@@ -18,6 +18,7 @@ from .router_pick import RouterPickPathRule
 from .swap_order import SwapOrderRule
 from .trace_emit import TraceEmitHygieneRule
 from .kv_boundary import KVBoundaryRule
+from .migration_state import MigrationStateSafetyRule
 
 ALL_RULES = [
     TraceSafetyRule(),
@@ -33,6 +34,7 @@ ALL_RULES = [
     RouterPickPathRule(),
     TraceEmitHygieneRule(),
     KVBoundaryRule(),
+    MigrationStateSafetyRule(),
 ]
 
 

@@ -41,8 +41,13 @@ _SCOPE = re.compile(r"(^|/)serving/")
 _PICK_FNS = frozenset({"_pick"})
 # proxy holds the prefill-pool pull slot; _forward (its failover loop,
 # split out so that slot's try/finally brackets it) holds the main-pool
-# pair. Both are the sanctioned accounting sites.
-_INFLIGHT_MUTATION_FNS = frozenset({"proxy", "_forward", "__init__"})
+# pair; _failover_midstream holds the resume-target pair while a
+# re-dispatched stream relays (its TARGET is not a load-balanced pick at
+# all — it must be the ring successor where the dying replica's migration
+# push parked the stream's KV, a state-locality lookup _pick cannot
+# express). All three are sanctioned accounting sites.
+_INFLIGHT_MUTATION_FNS = frozenset({"proxy", "_forward",
+                                    "_failover_midstream", "__init__"})
 _SELECTORS = frozenset({"min", "max", "sorted"})
 _RANDOM_PICKS = frozenset({"choice", "randrange", "randint", "sample",
                            "shuffle"})

@@ -81,7 +81,8 @@ def _scrape_annotations(port: int) -> dict:
     }
 
 
-def _engine_args(spec: dict, role: Optional[str] = None) -> list[str]:
+def _engine_args(spec: dict, role: Optional[str] = None,
+                 peer_urls: Optional[list[str]] = None) -> list[str]:
     cfg = spec.get("vllmConfig") or {}
     args = ["--model", str(spec["modelURL"]),
             "--port", str(ENGINE_PORT)]
@@ -139,6 +140,18 @@ def _engine_args(spec: dict, role: Optional[str] = None) -> list[str]:
         if cfg.get("numSpeculativeTokens") is not None:
             args += ["--num-speculative-tokens",
                      str(cfg["numSpeculativeTokens"])]
+    if cfg.get("migrationBudgetSeconds") is not None:
+        # Session survivability: live KV migration on drain makes SIGTERM
+        # transfer-bound, so the engine's wait-it-out fallback must fit the
+        # same (much tighter) budget — drain_grace_s mirrors the knob that
+        # also derives terminationGracePeriodSeconds in _pod_spec.
+        args += ["--drain-grace-s", str(int(cfg["migrationBudgetSeconds"]))]
+        if peer_urls:
+            # Drain-push allowlist (mirror of --prefill-pool): the SIGTERM
+            # drain may only migrate running streams to sibling pods of the
+            # same pool — a client reaching the pod directly cannot point
+            # the push at an arbitrary URL (SSRF guard).
+            args += ["--peer-pool", ",".join(peer_urls)]
     # enableChunkedPrefill needs no flag: long prompts always chunk here.
     if os.path.isabs(str(spec["modelURL"])):
         # Local checkpoint dir (hostPath-mounted): weights + tokenizer live
@@ -149,8 +162,16 @@ def _engine_args(spec: dict, role: Optional[str] = None) -> list[str]:
     return args
 
 
+# Graceful-drain pod timing: the preStop sleep that lets endpoint removal
+# propagate before SIGTERM, and the post-drain margin for flight-recorder
+# dumps + process exit before SIGKILL.
+PRESTOP_SLEEP_S = 5
+DRAIN_EXIT_MARGIN_S = 10
+
+
 def _pod_spec(spec: dict, engine: dict, multihost: bool,
-              role: Optional[str] = None) -> dict:
+              role: Optional[str] = None,
+              peer_urls: Optional[list[str]] = None) -> dict:
     name = spec["name"]
     tpus = int(spec.get("requestGPU", 0) or 0)
     resources: dict[str, Any] = {"requests": {}, "limits": {}}
@@ -191,7 +212,7 @@ def _pod_spec(spec: dict, engine: dict, multihost: bool,
         "imagePullPolicy": spec.get("imagePullPolicy", "IfNotPresent"),
         "command": ["python", "-m",
                     "kubernetes_gpu_cluster_tpu.serving.api_server"],
-        "args": (_engine_args(spec, role=role)
+        "args": (_engine_args(spec, role=role, peer_urls=peer_urls)
                  + (["--distributed"] if multihost else [])),
         "ports": [{"containerPort": ENGINE_PORT, "name": "http"}],
         "resources": resources,
@@ -210,15 +231,31 @@ def _pod_spec(spec: dict, engine: dict, multihost: bool,
         # engine's drain_grace_s (120 s default) or SIGKILL truncates
         # streams the drain was built to protect.
         "lifecycle": {"preStop": {"exec": {
-            "command": ["sh", "-c", "sleep 5"]}}},
+            "command": ["sh", "-c", f"sleep {PRESTOP_SLEEP_S}"]}}},
     }
     if env:
         container["env"] = env
     if mounts:
         container["volumeMounts"] = mounts
 
+    # terminationGracePeriodSeconds: with live KV migration on drain
+    # (vllmConfig.migrationBudgetSeconds) the SIGTERM path is TRANSFER-bound
+    # — each running stream's KV pages push to a peer in seconds — so the
+    # pod needs only budget + preStop + exit margin before SIGKILL, not the
+    # decode-bound default of 150 (drain_grace_s 120 + the same margins)
+    # that waits out the longest in-flight decode.
+    mig_budget = (spec.get("vllmConfig") or {}).get("migrationBudgetSeconds")
+    if mig_budget is not None:
+        mig_budget = int(mig_budget)
+        if mig_budget < 1:
+            raise ValueError(
+                f"modelSpec '{name}': migrationBudgetSeconds must be >= 1 "
+                f"(got {mig_budget})")
+        grace = mig_budget + PRESTOP_SLEEP_S + DRAIN_EXIT_MARGIN_S
+    else:
+        grace = 150
     pod: dict[str, Any] = {"containers": [container],
-                           "terminationGracePeriodSeconds": 150}
+                           "terminationGracePeriodSeconds": grace}
     if volumes:
         pod["volumes"] = volumes
     if engine.get("runtimeClassName"):
@@ -316,9 +353,14 @@ def _render_disagg_model(spec: dict, engine: dict,
     for role, count in (("prefill", disagg[0]), ("decode", disagg[1])):
         pool = f"{name}-{role}"
         labels = _labels(pool, "serving-engine")
+        # Decode pods are the only stream holders: under a migration
+        # budget their SIGTERM drain pushes running streams to pool
+        # siblings (prefill pods hold no streams and get no peer pool).
+        peers = _pod_urls(pool, count) if role == "decode" else None
         pod = {"metadata": {"labels": labels,
                             "annotations": _scrape_annotations(ENGINE_PORT)},
-               "spec": _pod_spec(spec, engine, False, role=role)}
+               "spec": _pod_spec(spec, engine, False, role=role,
+                                 peer_urls=peers)}
         out[f"{name}-{role}-engine-statefulset.yaml"] = {
             "apiVersion": "apps/v1",
             "kind": "StatefulSet",
@@ -361,9 +403,15 @@ def _render_model(spec: dict, engine: dict,
     labels = _labels(name, "serving-engine")
     sel = {"matchLabels": labels}
     meta = {"name": f"kgct-{name}-engine", "labels": labels}
+    # Peer pool for drain migration: only per-pod-addressed siblings can be
+    # named (the affinity StatefulSet). A Deployment's pods have no stable
+    # DNS (migration falls back to the trust-the-network default), and a
+    # multihost group is ONE lockstepped serving target with no peers.
+    peers = (_pod_urls(name, int(spec.get("replicaCount", 1)))
+             if affinity and not multihost else None)
     pod = {"metadata": {"labels": labels,
                         "annotations": _scrape_annotations(ENGINE_PORT)},
-           "spec": _pod_spec(spec, engine, multihost)}
+           "spec": _pod_spec(spec, engine, multihost, peer_urls=peers)}
     out: dict[str, dict] = {}
 
     if multihost:

@@ -922,7 +922,8 @@ class LLMEngine:
     def add_request(self, request_id: str, prompt_token_ids: list[int],
                     params: Optional[SamplingParams] = None,
                     hold_kv: bool = False,
-                    arrival_t0: Optional[float] = None) -> None:
+                    arrival_t0: Optional[float] = None,
+                    resume_outputs: Optional[list[int]] = None) -> None:
         """``hold_kv``: disaggregated-prefill mode — when the request
         finishes (normally with max_tokens=1 on a prefill replica), its
         committed KV pages are HELD for :meth:`export_held` instead of
@@ -932,7 +933,19 @@ class LLMEngine:
         decode replica whose handoff pull failed admits the request only
         AFTER the pull burned its wall time, and that wait is part of the
         client-observed TTFT/queue-wait span the SLO gauges exist to
-        catch."""
+        catch.
+
+        ``resume_outputs``: token-replay resume (mid-stream failover with
+        no migrated KV): the tokens a dead replica already generated are
+        pre-seeded as OUTPUT history, so admission replays prompt+outputs
+        through the recompute-preemption prefill path (``all_token_ids``)
+        and decoding continues from the next position. max_tokens/penalty
+        accounting see the replayed tokens as outputs (the prompt/output
+        boundary is preserved), and for greedy or seeded sampling the
+        continuation is byte-identical to the uninterrupted run (sample
+        keys derive from (seed, position), engine-independent). Raises
+        ValueError when the replayed history already satisfies a stop
+        condition — there is nothing left to generate."""
         params = params or SamplingParams()
         if params.logit_bias:
             # Out-of-vocab ids would be silently dropped by the device
@@ -948,6 +961,13 @@ class LLMEngine:
         seq.hold_kv = hold_kv
         if arrival_t0 is not None:
             seq.arrival_time = min(arrival_t0, seq.arrival_time)
+        if resume_outputs:
+            for tok in resume_outputs:
+                seq.append_token(int(tok))
+            if seq.check_stop(self.config.effective_max_len) is not None:
+                raise ValueError(
+                    f"resume history of {len(resume_outputs)} tokens "
+                    "already satisfies a stop condition; nothing to resume")
         self.obs.on_arrival(seq)
         try:
             self.scheduler.add(seq)
@@ -997,6 +1017,27 @@ class LLMEngine:
 
     # -- disaggregated prefill/decode (KV handoff seam) ----------------------
 
+    def _export_state(self, seq: Sequence, k_np, v_np) -> dict:
+        """The serialized cross-replica sequence state, built from
+        COMMITTED quantities only: the sequence's host-known token/logprob
+        history and the already-fetched committed-page buffers. Nothing
+        from an in-flight window (device-resident sampled tokens, window
+        scratch) may enter this dict — the KGCT014 lint rule polices the
+        export path statically."""
+        return {
+            "model": self.model_config.name,
+            "page_size": self.config.cache.page_size,
+            "dtype": str(self.kv_cache.k.dtype),
+            "prompt_token_ids": list(seq.prompt_token_ids),
+            "output_token_ids": list(seq.output_token_ids),
+            "output_logprobs": list(seq.output_logprobs),
+            "output_top_logprobs": [
+                [[int(t), float(lp)] for t, lp in top]
+                for top in seq.output_top_logprobs],
+            "sampling": seq.params.to_state(),
+            "k": k_np, "v": v_np,
+        }
+
     def export_held(self, request_id: str) -> dict:
         """Serialize a held finished prefill (``add_request(hold_kv=True)``)
         into one contiguous host-buffer state dict: the sequence's committed
@@ -1016,18 +1057,60 @@ class LLMEngine:
         # (KGCT010 ordering).
         self.scheduler.allocator.free(seq.pages)
         seq.pages = []
-        return {
-            "model": self.model_config.name,
-            "page_size": ps,
-            "dtype": str(self.kv_cache.k.dtype),
-            "prompt_token_ids": list(seq.prompt_token_ids),
-            "output_token_ids": list(seq.output_token_ids),
-            "output_logprobs": list(seq.output_logprobs),
-            "output_top_logprobs": [
-                [[int(t), float(lp)] for t, lp in top]
-                for top in seq.output_top_logprobs],
-            "k": k_np, "v": v_np,
-        }
+        return self._export_state(seq, k_np, v_np)
+
+    def export_running(self, request_id: str) -> dict:
+        """Live migration: snapshot a RUNNING sequence mid-decode into the
+        same wire state :meth:`export_held` produces — committed KV pages
+        (positions [0, num_tokens-1); the next decode step on the importing
+        side writes the last token's KV, exactly like swap restore) plus
+        the full host-known generation and sampling state — and retire it
+        locally (FinishReason.MIGRATE: terminal, but no client-facing
+        finish — the stream continues on the peer). For greedy and seeded
+        sampling the imported continuation is byte-identical to the
+        uninterrupted run.
+
+        Safe against the speculative decode-window chain: a sequence in
+        the in-flight window becomes a ZOMBIE (its already-sampled,
+        not-yet-fetched window tokens are discarded — the peer regenerates
+        them deterministically) and its pages are released only when the
+        chain drains, since the dispatched window still writes into them.
+        The gather itself serializes after the in-flight program on the
+        device stream and reads only committed positions' pages, which the
+        window never touches below position num_tokens-1.
+
+        Raises KeyError when no RUNNING sequence owns ``request_id`` and
+        RuntimeError when nothing is committed yet — the caller degrades
+        to the wait-it-out drain path."""
+        seq = self.scheduler.find_running(request_id)
+        if seq is None:
+            raise KeyError(f"no running sequence {request_id!r}")
+        ps = self.config.cache.page_size
+        n = cdiv(seq.num_tokens - 1, ps)
+        if n < 1 or n > len(seq.pages) or not seq.output_token_ids:
+            raise RuntimeError(
+                f"{request_id!r} has no committed KV to migrate")
+        k_np, v_np = self.kv_io.export_pages(seq.pages[:n])
+        state = self._export_state(seq, k_np, v_np)
+        state["mid_stream"] = True
+        # Retire locally. Only now (gather fetched) may pages be released
+        # (KGCT010); a sequence in the in-flight window defers the release
+        # to the chain drain (pending device writes target its pages).
+        self.scheduler.running.remove(seq)
+        seq.status = SequenceStatus.FINISHED
+        seq.finish_reason = FinishReason.MIGRATE
+        inflight = self._inflight
+        if inflight is not None and seq in inflight["batch"].seqs:
+            inflight["zombies"].add(request_id)
+            self._deferred_release.append(seq)
+        elif seq.pages:
+            self.scheduler.allocator.free(seq.pages)
+            seq.pages = []
+        self.stats.requests_finished += 1
+        self.obs.on_finish(seq, FinishReason.MIGRATE)
+        self.obs.tracer.emit("migrate", request_id, side="export", pages=n,
+                             tokens=len(state["output_token_ids"]))
+        return state
 
     def discard_held(self, request_id: str) -> None:
         """Release a held prefill whose export never happened (client died
@@ -1051,6 +1134,12 @@ class LLMEngine:
         # (pull start): now - t0 is the replica-observed TTFT — remote
         # prefill + transfer + import — the client-facing span.
         ttft_t0 = state.pop("_ttft_t0", None)
+        # Mid-stream migration state (export_running): the client already
+        # received its first token on the exporting replica, so no TTFT
+        # sample fires here; the serialized sampling snapshot is forensic
+        # (the caller derives params from the original request body).
+        mid_stream = bool(state.pop("mid_stream", False))
+        state.pop("sampling", None)
         ps = self.config.cache.page_size
         if state.get("model") != self.model_config.name:
             raise ValueError(f"handoff model {state.get('model')!r} != "
@@ -1110,14 +1199,15 @@ class LLMEngine:
         sched.running.append(seq)
         self.obs.on_arrival(seq)
         self.obs.on_scheduled(seq, 1)
-        if ttft_t0 is not None:
+        if ttft_t0 is not None and not mid_stream:
             # step() never fires on_first_token for an imported sequence
             # (append_token above already stamped first_token_time), so the
             # TTFT sample — histogram + SLO attainment window + the goodput
             # gate on_finish applies — lands here with the true span.
             self.obs.on_handoff_first_token(
                 seq, max(time.monotonic() - ttft_t0, 0.0))
-        self.obs.tracer.emit("handoff", request_id, side="import",
+        self.obs.tracer.emit("migrate" if mid_stream else "handoff",
+                             request_id, side="import",
                              pages=need, tokens=len(out_ids))
         if self._sanitizer is not None:
             # The KV-slot shadow learns the imported slots are committed

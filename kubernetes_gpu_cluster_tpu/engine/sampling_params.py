@@ -76,3 +76,19 @@ class SamplingParams:
                                      "[-100, 100]")
                 clean[tok] = bias
             self.logit_bias = clean
+
+    def to_state(self) -> dict:
+        """JSON-serializable snapshot for the live-migration export: the
+        byte-identity of a resumed stream depends on EVERY sampling knob
+        (seed, penalties, bias, stop set) surviving the hop."""
+        d = dataclasses.asdict(self)
+        d["stop_token_ids"] = list(self.stop_token_ids)
+        return d
+
+    @staticmethod
+    def from_state(d: dict) -> "SamplingParams":
+        """Inverse of :meth:`to_state`. JSON round-trips logit_bias keys to
+        strings; __post_init__ re-ints them."""
+        kw = dict(d)
+        kw["stop_token_ids"] = tuple(kw.get("stop_token_ids") or ())
+        return SamplingParams(**kw)

@@ -196,6 +196,16 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.running or self.swapped)
 
+    def find_running(self, request_id: str) -> Optional[Sequence]:
+        """The RUNNING sequence under ``request_id``, else None. The
+        live-migration export seam (engine.export_running) migrates running
+        decodes only: waiting/swapped sequences have no committed device
+        pages worth shipping and keep the wait-it-out drain path."""
+        for seq in self.running:
+            if seq.request_id == request_id:
+                return seq
+        return None
+
     def _release(self, seq: Sequence) -> None:
         if seq.pages:
             self.allocator.free(seq.pages)

@@ -21,6 +21,10 @@ class FinishReason(enum.Enum):
     STOP = "stop"              # hit EOS / stop token
     LENGTH = "length"          # hit max_tokens or max_model_len
     ABORT = "abort"            # client cancelled
+    MIGRATE = "migrated"       # live-migrated to a peer replica (drain):
+                               # the stream continues elsewhere; locally the
+                               # sequence is terminal without a client-facing
+                               # finish
 
 
 class Sequence:

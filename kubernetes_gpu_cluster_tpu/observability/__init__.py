@@ -127,6 +127,11 @@ def _outcome(seq, reason) -> str:
     rv = getattr(reason, "value", reason)
     if rv == "abort":
         return "aborted"
+    if rv == "migrated":
+        # Live-migrated to a peer (drain): locally terminal, but the client
+        # stream continues elsewhere — its tokens WERE delivered, so the
+        # goodput gate keeps them; the e2e series splits them out.
+        return "migrated"
     if getattr(seq, "preempt_count", 0) > 0:
         return "preempted"
     return "finished"

@@ -34,8 +34,8 @@ from typing import Optional
 # (upstream headers latency), "relay" (stream relay complete, bytes).
 EVENT_KINDS = ("arrival", "queued", "scheduled", "prefill_chunk",
                "first_token", "decode", "mixed", "spec", "preempt",
-               "swap", "handoff", "resume", "finish", "abort",
-               "pick", "connect_retry", "ttfb", "relay")
+               "swap", "handoff", "migrate", "resume", "finish", "abort",
+               "pick", "connect_retry", "ttfb", "relay", "failover")
 
 # Events that OPEN / CLOSE a request's async span in the Perfetto export.
 _OPEN = "arrival"

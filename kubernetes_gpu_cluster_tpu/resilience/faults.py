@@ -33,6 +33,14 @@ point (grep for ``inject(`` / ``fault_value(``):
 - ``kv_handoff_fail``  decode replica: the disaggregated KV-handoff pull
                        raises before contacting the prefill replica ->
                        graceful local-recompute fallback
+- ``replica_kill_midstream``  router: the upstream socket is severed after
+                       N relayed chunks (param ``after``) -> transparent
+                       mid-stream failover to a ring successor via
+                       /internal/resume (truncated-error rung when resume
+                       is impossible)
+- ``migrate_fail``     draining replica: the live-migration export/push
+                       raises before the sequence detaches -> per-sequence
+                       fallback to the wait-it-out drain path
 
 Params (all optional): ``p`` fire probability in [0, 1] (default 1; drawn
 from a PRIVATE ``random.Random(seed)`` per rule, so sequences are

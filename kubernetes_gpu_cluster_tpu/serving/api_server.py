@@ -64,11 +64,13 @@ from ..resilience.drain import drain_and_notify
 from ..resilience.faults import inject as _inject_fault
 from ..utils import get_logger
 from .async_engine import AsyncLLMEngine
-from .errors import (PREFILL_URL_HEADER, REQUEST_ID_HEADER,
-                     valid_request_id)
+from .errors import (MIGRATE_URL_HEADER, PREFILL_URL_HEADER,
+                     REQUEST_ID_HEADER, RESUME_MODE_HEADER,
+                     StreamMigratedError, valid_request_id)
 from .errors import overloaded_error as _overloaded
-from .handoff import (HANDOFF_TIMEOUT_S, decode_handoff, encode_handoff,
-                      fetch_handoff, handoff_request_body)
+from .handoff import (HANDOFF_TIMEOUT_S, MIGRATE_PUSH_TIMEOUT_S,
+                      MigrationStore, decode_handoff, encode_handoff,
+                      fetch_handoff, handoff_request_body, push_handoff)
 from .metrics import Metrics
 from .tokenizer import (IncrementalDetokenizer, Tokenizer,
                         apply_chat_template, load_tokenizer)
@@ -132,6 +134,48 @@ class DisaggStats:
         return lines
 
 
+class MigrationStats:
+    """Session-survivability accounting, rendered on /metrics next to the
+    disaggregation series. Sides: "push" (a draining replica ships a
+    running sequence), "recv" (a peer parks a pushed state), "resume" (the
+    router's failover re-dispatch reconstructs a stream here — outcome
+    "ok" = parked-KV import, "fallback" = token-replay recompute). Zeros
+    when migration never ran — a fresh scrape is nan-free."""
+
+    def __init__(self):
+        self.migrations: dict[tuple, int] = {}
+        self.bytes: dict[str, int] = {}
+        self.latency = Histogram(
+            "kgct_migration_seconds",
+            "mid-stream migration wall latency (push / recv / resume)",
+            labels=("side",))
+
+    def on_migrate(self, side: str, outcome: str, n_bytes: int = 0,
+                   duration_s: Optional[float] = None) -> None:
+        key = (side, outcome)
+        self.migrations[key] = self.migrations.get(key, 0) + 1
+        if n_bytes:
+            self.bytes[side] = self.bytes.get(side, 0) + n_bytes
+        if duration_s is not None:
+            self.latency.observe(duration_s, (side,))
+
+    def render(self) -> list[str]:
+        lines = ["# TYPE kgct_migrations_total counter"]
+        keys = {("push", "ok"), ("push", "fallback"), ("recv", "ok"),
+                ("resume", "ok"), ("resume", "fallback"),
+                ("recv", "error")} | set(self.migrations)
+        for side, outcome in sorted(keys):
+            lines.append(
+                f'kgct_migrations_total{{side="{side}",'
+                f'outcome="{outcome}"}} {self.migrations.get((side, outcome), 0)}')
+        lines.append("# TYPE kgct_migration_bytes_total counter")
+        for side in sorted({"push", "recv"} | set(self.bytes)):
+            lines.append(f'kgct_migration_bytes_total{{side="{side}"}} '
+                         f'{self.bytes.get(side, 0)}')
+        lines.extend(self.latency.render())
+        return lines
+
+
 def _sampling_params(body: dict, eos_token_id: Optional[int],
                      n_logprobs: int = 0) -> SamplingParams:
     seed = body.get("seed")
@@ -184,7 +228,8 @@ class APIServer:
                  model_name: str,
                  resilience: Optional[ResilienceConfig] = None,
                  role: str = "both",
-                 prefill_pool: Optional[list] = None):
+                 prefill_pool: Optional[list] = None,
+                 peer_pool: Optional[list] = None):
         if role not in REPLICA_ROLES:
             raise ValueError(f"unknown replica role {role!r} "
                              f"(known: {', '.join(REPLICA_ROLES)})")
@@ -194,12 +239,31 @@ class APIServer:
         self.metrics = Metrics(engine.engine)
         self.role = role
         self.disagg = DisaggStats(role)
+        self.migration = MigrationStats()
         # Engine-side import failures (no batch seat, no free pages, state
         # mismatch) surface AFTER the pull was counted outcome="ok" — the
         # worker degrades to local recompute and reports it here so the
         # fallback counter reflects replicas that recompute everything.
-        engine.on_import_fallback = (
-            lambda: self.disagg.on_handoff("import", "fallback"))
+        # Mid-stream (migration) imports attribute to the migration
+        # series instead: their recompute rung is token replay, a
+        # different operator story than a disagg prefill re-run.
+        engine.on_import_fallback = self._on_import_fallback
+        # Session survivability (live migration + mid-stream failover):
+        # parked mid-stream states pushed by draining peers, the live
+        # streams' migrate targets (rid -> (peer url, prompt ids, params),
+        # captured from the router-owned MIGRATE_URL_HEADER), and the
+        # bookkeeping that attributes an engine-side import failure to the
+        # resume series instead of the disagg one.
+        self.migrate_store = MigrationStore()
+        self._migrate_urls: dict[str, tuple] = {}
+        self._mid_stream_rids: set = set()
+        self._resume_fallbacks: set = set()
+        # Push allowlist (mirror of --prefill-pool): the migrate-url header
+        # is router-owned, but a client reaching the pod directly could
+        # otherwise point the drain push at an arbitrary URL. None = trust
+        # the network boundary (dev/tests).
+        self.peer_pool = (frozenset(u.rstrip("/") for u in peer_pool)
+                          if peer_pool else None)
         # KV handoff does not compose with multihost SPMD lockstep: an
         # import/hold on rank 0 alone would desynchronize the followers'
         # schedulers, so a mesh leader forces plain colocated serving.
@@ -246,13 +310,31 @@ class APIServer:
             "watchdog_trip", trips=self.watchdog.trips,
             timeout_s=self.watchdog.timeout_s)
 
+    def _on_import_fallback(self, rid: str = None) -> None:
+        """Engine-side import failure (worker thread). A mid-stream resume
+        import degrades to TOKEN REPLAY — a different operator story than a
+        disaggregated prefill re-run — so it lands in the migration series
+        (and flags the rid so the resume handler reports mode=recompute);
+        everything else keeps the pre-existing disagg attribution."""
+        if rid is not None and rid in self._mid_stream_rids:
+            self._resume_fallbacks.add(rid)
+            self.migration.on_migrate("resume", "fallback")
+        else:
+            self.disagg.on_handoff("import", "fallback")
+
     # -- app wiring ----------------------------------------------------------
 
     def build_app(self) -> web.Application:
-        app = web.Application(middlewares=[self._request_id_mw])
+        # client_max_size must admit a migration PUSH body (one sequence's
+        # KV pages as octet-stream — far over aiohttp's 1 MiB default);
+        # the recv handler re-checks the same bound explicitly.
+        app = web.Application(middlewares=[self._request_id_mw],
+                              client_max_size=self._handoff_max_bytes
+                              + (1 << 20))
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/internal/kv_handoff", self.kv_handoff)
+        app.router.add_post("/internal/resume", self.resume)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.prometheus)
@@ -303,19 +385,133 @@ class APIServer:
 
     def begin_drain(self, on_drained=None):
         """Start graceful drain (idempotent): stop admitting, flip /health,
-        finish in-flight work, then fire ``on_drained``. Returns the drain
-        task, or None if a drain was already running. Must be called on the
-        server's event loop (the SIGTERM handler and tests both are)."""
+        LIVE-MIGRATE every running stream that has a router-named peer
+        (drain time becomes transfer-bound instead of waiting out the
+        longest decode), finish whatever remains, then fire ``on_drained``.
+        Returns the drain task, or None if a drain was already running.
+        Must be called on the server's event loop (the SIGTERM handler and
+        tests both are)."""
         import asyncio
         if not self.drain_state.start_drain():
             return None
         # Black-box capture of the pre-drain seconds: what was queued or
         # mid-stream when the SIGTERM landed outlives the pod in the dump.
         self.engine.engine.obs.flight.dump(
-            "sigterm_drain", grace_s=self.res_config.drain_grace_s)
-        return asyncio.get_running_loop().create_task(drain_and_notify(
-            self.drain_state, self.engine,
-            grace_s=self.res_config.drain_grace_s, on_drained=on_drained))
+            "sigterm_drain", grace_s=self.res_config.drain_grace_s,
+            migrate_targets=len(self._migrate_urls))
+
+        async def _drain():
+            # The migrate phase spends part of the SAME budget the
+            # wait-it-out fallback gets: drain_grace_s bounds the WHOLE
+            # drain (the deploy renderer sizes
+            # terminationGracePeriodSeconds from it + fixed margins), so
+            # the fallback wait receives only what the pushes left over —
+            # otherwise a wedged peer burning the push timeout would push
+            # the total past the pod's SIGKILL deadline and hard-truncate
+            # the very streams the fallback exists to protect.
+            t0 = time.monotonic()
+            await self._drain_migrate()
+            remaining = max(
+                self.res_config.drain_grace_s - (time.monotonic() - t0),
+                1.0)
+            await drain_and_notify(
+                self.drain_state, self.engine,
+                grace_s=remaining, on_drained=on_drained)
+
+        return asyncio.get_running_loop().create_task(_drain())
+
+    async def _drain_migrate(self) -> None:
+        """Push every migratable running stream to its router-named peer.
+        Per-sequence and never-raising: any failure on any rung degrades
+        THAT sequence to the old wait-it-out drain path (or, past the
+        point of no return, to router token-replay failover) while the
+        rest keep migrating."""
+        import asyncio
+
+        import aiohttp
+        targets = list(self._migrate_urls.items())
+        if not targets:
+            return
+        if self._http is None:
+            self._http = aiohttp.ClientSession()
+        await asyncio.gather(
+            *(self._migrate_one(rid, url, ids, params)
+              for rid, (url, ids, params) in targets),
+            return_exceptions=True)
+
+    async def _migrate_one(self, rid: str, url: str, ids: list,
+                           params) -> None:
+        """One sequence's live migration: export_running (which retires it
+        locally) -> encode -> push to the peer's /internal/kv_handoff ->
+        sever the client relay so the router's failover re-dispatch finds
+        the parked state. Failure ladder: export failed -> the sequence
+        never detached, wait-it-out; push failed -> re-import the snapshot
+        locally (the stream resumes here as if never exported); re-import
+        failed too -> sever the relay anyway and let the router's
+        token-replay recompute rung carry the session."""
+        obs = self.engine.engine.obs
+        t0 = time.perf_counter()
+        try:
+            if _inject_fault("migrate_fail"):
+                raise RuntimeError(
+                    "KGCT_FAULT migrate_fail: injected migration failure")
+            state = await self.engine.run_in_worker(
+                lambda e: e.export_running(rid))
+        except KeyError:
+            return      # already finished: nothing to migrate
+        except Exception as e:
+            # Nothing detached: the stream keeps decoding here — the
+            # wait-it-out rung the pre-migration drain always took.
+            dt = time.perf_counter() - t0
+            self.migration.on_migrate("push", "fallback", 0, dt)
+            obs.tracer.emit("migrate", rid, side="push", outcome="fallback",
+                            error=str(e)[:200])
+            logger.warning("live migration of %s skipped (%s); waiting "
+                           "out the decode", rid, e,
+                           extra={"request_id": rid})
+            return
+        blob = encode_handoff(state)
+        try:
+            # One push may spend at most half the drain budget: the
+            # wait-it-out fallback (and a local re-import) must still fit
+            # inside drain_grace_s after a wedged peer times out.
+            await push_handoff(
+                self._http, url, blob, rid,
+                timeout_s=min(MIGRATE_PUSH_TIMEOUT_S,
+                              max(self.res_config.drain_grace_s / 2, 1.0)))
+        except Exception as e:
+            logger.warning("migration push of %s to %s failed (%s); "
+                           "re-importing locally", rid, url, e,
+                           extra={"request_id": rid})
+            dt = time.perf_counter() - t0
+            try:
+                # The export already retired the sequence — restore it
+                # from the snapshot (the same import a peer would run) so
+                # the client stream continues locally, wait-it-out style.
+                await self.engine.run_in_worker(
+                    lambda eng: eng.import_request(rid, ids, params, state))
+                self.migration.on_migrate("push", "fallback", len(blob), dt)
+                obs.tracer.emit("migrate", rid, side="push",
+                                outcome="fallback", error=str(e)[:200])
+            except Exception as e2:
+                # Point of no return: the KV is gone locally and the peer
+                # never parked it. Sever the relay — the router's failover
+                # recomputes from the relayed tokens (the recompute rung).
+                self.migration.on_migrate("push", "error", len(blob), dt)
+                obs.tracer.emit("migrate", rid, side="push",
+                                outcome="error", error=str(e2)[:200])
+                self._migrate_urls.pop(rid, None)
+                self.engine.post_exception(rid, StreamMigratedError(url))
+            return
+        dt = time.perf_counter() - t0
+        self.migration.on_migrate("push", "ok", len(blob), dt)
+        obs.tracer.emit("migrate", rid, side="push", outcome="ok",
+                        bytes=len(blob), ms=round(dt * 1e3, 2))
+        self._migrate_urls.pop(rid, None)
+        # The broken relay IS the router's failover signal: no terminal
+        # SSE frame, just a severed stream (engine state is already gone —
+        # post_exception touches only the output queue).
+        self.engine.post_exception(rid, StreamMigratedError(url))
 
     def _admission_gate(self, request: web.Request) -> Optional[web.Response]:
         """None = admit. A Response = reject BEFORE the request touches the
@@ -371,7 +567,8 @@ class APIServer:
     async def prometheus(self, request: web.Request) -> web.Response:
         text = (self.metrics.render()
                 + "\n".join(self.hub.render_prometheus()) + "\n"
-                + "\n".join(self.disagg.render()) + "\n")
+                + "\n".join(self.disagg.render()) + "\n"
+                + "\n".join(self.migration.render()) + "\n")
         return web.Response(text=text, content_type="text/plain")
 
     async def trace(self, request: web.Request) -> web.Response:
@@ -469,7 +666,14 @@ class APIServer:
         The decode replica imports it as committed history and resumes
         decode directly; the first token samples here with the client's
         sampling params, so the disaggregated output is byte-identical to
-        a colocated run. Served by ``prefill``/``both`` roles only."""
+        a colocated run. Served by ``prefill``/``both`` roles only.
+
+        The PUSH direction (octet-stream content type) is the live-
+        migration receive: a draining peer ships a running sequence's
+        mid-stream state here and it is PARKED host-side (MigrationStore)
+        until the router's /internal/resume re-dispatch claims it."""
+        if request.content_type == "application/octet-stream":
+            return await self._kv_handoff_recv(request)
         if self.role == "decode" or not self._handoff_ok:
             return _error(404, f"kv handoff is not served by this replica "
                                f"(role={self.role})")
@@ -542,6 +746,250 @@ class APIServer:
                             content_type="application/octet-stream",
                             headers={REQUEST_ID_HEADER: rid})
 
+    # -- session survivability (live migration + mid-stream failover) --------
+
+    async def _kv_handoff_recv(self, request: web.Request) -> web.Response:
+        """Receive a draining peer's mid-stream push and PARK it (host
+        memory only — no device pages are spent on a stream whose client
+        may never fail over here). The router's /internal/resume claims it
+        by request id; TTL/cap bounds in MigrationStore keep a crashing
+        fleet from ballooning this replica."""
+        if self.role == "prefill" or not self._handoff_ok:
+            self.migration.on_migrate("recv", "error")
+            return _error(404, "migration push is not served by this "
+                               f"replica (role={self.role})")
+        if self.drain_state.is_draining:
+            # A draining replica is the wrong parking lot — the pusher
+            # falls back and the router walks on.
+            self.migration.on_migrate("recv", "error")
+            return _overloaded(503, "server is draining; push elsewhere", 1)
+        rid = valid_request_id(request.headers.get(REQUEST_ID_HEADER))
+        if rid is None:
+            self.migration.on_migrate("recv", "error")
+            return _error(400, "migration push requires a valid "
+                               f"{REQUEST_ID_HEADER}")
+        t0 = time.perf_counter()
+        data = await request.read()
+        if len(data) > self._handoff_max_bytes:
+            self.migration.on_migrate("recv", "error")
+            return _error(413, "migration blob exceeds the local KV bound")
+        try:
+            state = decode_handoff(data)
+        except ValueError as e:
+            self.migration.on_migrate("recv", "error")
+            return _error(400, f"bad migration blob: {e}")
+        if not state.get("mid_stream"):
+            self.migration.on_migrate("recv", "error")
+            return _error(400, "not a mid-stream migration state")
+        if state.get("model") != self.engine.engine.model_config.name:
+            self.migration.on_migrate("recv", "error")
+            return _error(409, f"migration model {state.get('model')!r} != "
+                               f"{self.engine.engine.model_config.name!r}")
+        self.migrate_store.put(rid, state)
+        dt = time.perf_counter() - t0
+        self.migration.on_migrate("recv", "ok", len(data), dt)
+        self.engine.engine.obs.tracer.emit(
+            "migrate", rid, side="recv", bytes=len(data),
+            tokens=len(state.get("output_token_ids") or []),
+            ms=round(dt * 1e3, 2))
+        return web.json_response({"parked": True, "request_id": rid})
+
+    def _prompt_ids_of(self, body: dict, kind: str):
+        """(prompt token ids, error response): THE one tokenization of a
+        completion body — the /v1 handlers and the failover resume
+        re-dispatch must share it, or a replayed prompt could stop matching
+        the parked state byte-for-byte."""
+        if kind == "chat.completion":
+            messages = body.get("messages")
+            if not messages:
+                return None, _error(400, "missing 'messages'")
+            return self.tokenizer.encode(
+                apply_chat_template(self.tokenizer, messages)), None
+        prompt = body.get("prompt")
+        if prompt is None:
+            return None, _error(400, "missing 'prompt'")
+        if isinstance(prompt, list):
+            if prompt and isinstance(prompt[0], int):
+                return [int(t) for t in prompt], None
+            if len(prompt) == 1 and isinstance(prompt[0], str):
+                return self.tokenizer.encode(prompt[0]), None
+            return None, _error(400, "batched prompts are not supported; "
+                                     "send one request per prompt")
+        return self.tokenizer.encode(prompt), None
+
+    async def resume(self, request: web.Request) -> web.StreamResponse:
+        """Mid-stream failover re-dispatch: reconstruct a dead replica's
+        live stream and continue it as SSE, emitting ONLY the tokens the
+        client has not seen. Body: {"body": <original request body>,
+        "relayed_token_ids": [...], "kind": "completion"|"chat.completion"}.
+
+        Resume ladder: a parked migration state for this request id
+        imports directly (mode "import": KV scatter, no recompute); no
+        parked state — or a failed import — replays the relayed tokens as
+        forced context through the recompute-prefill path (mode
+        "recompute", byte-identical for greedy/seeded sampling). The mode
+        is echoed in RESUME_MODE_HEADER for the router's failover
+        attribution."""
+        if self.role == "prefill" or not self._handoff_ok:
+            return _error(404, "resume is not served by this replica "
+                               f"(role={self.role})")
+        if self.drain_state.is_draining:
+            return _overloaded(503, "server is draining; resume elsewhere",
+                               1)
+        try:
+            envelope = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        body = envelope.get("body")
+        relayed = envelope.get("relayed_token_ids")
+        kind = envelope.get("kind") or "completion"
+        if not isinstance(body, dict):
+            return _error(400, "resume requires the original request body")
+        if (not isinstance(relayed, list)
+                or not all(isinstance(t, int) and not isinstance(t, bool)
+                           for t in relayed)):
+            return _error(400, "relayed_token_ids must be a list of ints")
+        if kind not in ("completion", "chat.completion"):
+            return _error(400, f"unknown resume kind {kind!r}")
+        rid = valid_request_id(request.headers.get(REQUEST_ID_HEADER))
+        if rid is None:
+            return _error(400, "resume requires a valid "
+                               f"{REQUEST_ID_HEADER}")
+        request["kgct_request_id"] = rid
+        ids, err = self._prompt_ids_of(body, kind)
+        if err is not None:
+            return err
+        n_lp, lp_err = _logprobs_requested(body)
+        if lp_err is not None:
+            return lp_err
+        want_lps = n_lp >= 1 and kind == "completion"
+        try:
+            params = _sampling_params(body, self.tokenizer.eos_token_id,
+                                      n_logprobs=n_lp)
+        except (TypeError, ValueError) as e:
+            return _error(400, str(e))
+        obs = self.engine.engine.obs
+        parked = self.migrate_store.pop(rid)
+        if parked is not None:
+            # The parked outputs must EXTEND what the client already saw,
+            # or the import would desynchronize the stream — a stale or
+            # foreign snapshot drops to token replay instead.
+            po = list(parked.get("output_token_ids") or [])
+            if po[:len(relayed)] != list(relayed):
+                obs.tracer.emit("migrate", rid, side="resume",
+                                outcome="stale_park",
+                                parked=len(po), relayed=len(relayed))
+                parked = None
+        detok = IncrementalDetokenizer(self.tokenizer, stop=_stops(body))
+        migrate_url = request.headers.get(MIGRATE_URL_HEADER)
+        rid = self._reserve_rid(request, rid)
+        t0 = time.perf_counter()
+        self._mid_stream_rids.add(rid)
+        gen = self.engine.generate(rid, ids, params, handoff=parked,
+                                   resume_outputs=list(relayed))
+        complete = False
+        resp = None
+        n_out = len(relayed)
+        try:
+            try:
+                first = await gen.__anext__()
+            except StopAsyncIteration:
+                complete = True
+                return _error(500, "resume produced no output")
+            mode = "import" if (parked is not None
+                                and rid not in self._resume_fallbacks) \
+                else "recompute"
+            dt = time.perf_counter() - t0
+            if mode == "import":
+                self.migration.on_migrate("resume", "ok", 0, dt)
+            elif parked is None:
+                # No parked state was ever available: pure token replay
+                # (the fallback-after-import case already counted through
+                # the on_import_fallback hook).
+                self.migration.on_migrate("resume", "fallback", 0, dt)
+            obs.tracer.emit("migrate", rid, side="resume", outcome=mode,
+                            relayed=len(relayed), ms=round(dt * 1e3, 2))
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                REQUEST_ID_HEADER: rid,
+                RESUME_MODE_HEADER: mode})
+            await resp.prepare(request)
+            # A resumed stream is itself migratable (nested drains).
+            if (migrate_url
+                    and migrate_url.startswith(("http://", "https://"))
+                    and (self.peer_pool is None
+                         or migrate_url.rstrip("/") in self.peer_pool)):
+                self._migrate_urls[rid] = (migrate_url, list(ids), params)
+            # Seed the detokenizer with the relayed prefix: its emission is
+            # byte-identical to what the dead replica already delivered
+            # (same deterministic incremental function over the same
+            # tokens), so only genuinely-new text leaves here.
+            if relayed:
+                self._detok_push(detok, list(relayed), False)
+            emitted = len(relayed)
+            created = int(time.time())
+
+            async def frames():
+                yield first
+                async for c in gen:
+                    yield c
+
+            async for chunk in frames():
+                full = list(chunk.output_token_ids)
+                new_ids = full[emitted:] if len(full) > emitted else []
+                emitted = max(emitted, len(full))
+                n_out = len(full)
+                delta = self._detok_push(detok, new_ids, chunk.finished)
+                finished = chunk.finished or detok.stopped
+                if detok.stopped and not chunk.finished:
+                    self.engine.abort(rid)
+                if delta or finished or new_ids:
+                    reason = ("stop" if detok.stopped
+                              else _map_reason(chunk.finish_reason))
+                    sb = _stream_body(kind, rid, created, self.model_name,
+                                      delta, reason if finished else None)
+                    # The router's failover relay consumes these (and
+                    # strips them before the client): the token ledger a
+                    # SECOND failover would replay.
+                    if new_ids:
+                        sb["kgct_token_ids"] = new_ids
+                    if want_lps and new_ids and not detok.stopped:
+                        lps = list(chunk.new_logprobs or [])
+                        sb["choices"][0]["logprobs"] = {
+                            "tokens": [self.tokenizer.decode([t])
+                                       for t in new_ids],
+                            "token_logprobs": lps[-len(new_ids):],
+                        }
+                    await resp.write(_sse(sb))
+                if finished:
+                    complete = True
+                    break
+        except ValueError as e:
+            complete = True
+            if resp is None:
+                self.migration.on_migrate("resume", "error")
+                return _error(400, str(e))
+            await resp.write(_sse({"error": {"message": str(e),
+                                             "code": 400}}))
+        except StreamMigratedError as e:
+            # Migrated AGAIN mid-resume (nested drain): sever this relay
+            # too — the router walks to the next rung.
+            obs.tracer.emit("migrate", rid, side="resume",
+                            outcome="re_migrated", peer=e.peer_url)
+            raise
+        finally:
+            self._mid_stream_rids.discard(rid)
+            self._resume_fallbacks.discard(rid)
+            self._migrate_urls.pop(rid, None)
+            if not self.engine.release_reservation(rid) and not complete:
+                self.engine.abort(rid)
+        self.metrics.on_request()
+        self.metrics.on_finish(max(n_out - len(relayed), 0))
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
     async def _pull_handoff(self, prefill_url: str, rid: str, body: dict,
                             ids: list[int]) -> Optional[dict]:
         """Decode-replica half: pull the prefilled KV from ``prefill_url``
@@ -586,19 +1034,9 @@ class APIServer:
             body = await request.json()
         except Exception:
             return _error(400, "invalid JSON body")
-        prompt = body.get("prompt")
-        if prompt is None:
-            return _error(400, "missing 'prompt'")
-        if isinstance(prompt, list):
-            if prompt and isinstance(prompt[0], int):
-                ids = [int(t) for t in prompt]
-            elif len(prompt) == 1 and isinstance(prompt[0], str):
-                ids = self.tokenizer.encode(prompt[0])
-            else:
-                return _error(400, "batched prompts are not supported; "
-                                   "send one request per prompt")
-        else:
-            ids = self.tokenizer.encode(prompt)
+        ids, err = self._prompt_ids_of(body, "completion")
+        if err is not None:
+            return err
         return await self._run(request, body, ids, kind="completion")
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
@@ -606,11 +1044,9 @@ class APIServer:
             body = await request.json()
         except Exception:
             return _error(400, "invalid JSON body")
-        messages = body.get("messages")
-        if not messages:
-            return _error(400, "missing 'messages'")
-        text = apply_chat_template(self.tokenizer, messages)
-        ids = self.tokenizer.encode(text)
+        ids, err = self._prompt_ids_of(body, "chat.completion")
+        if err is not None:
+            return err
         return await self._run(request, body, ids, kind="chat.completion")
 
     # -- request execution ---------------------------------------------------
@@ -726,6 +1162,20 @@ class APIServer:
         self.metrics.on_request()
 
         rid = self._reserve_rid(request, rid)
+        # Session survivability: the router names the peer a drain should
+        # push this stream's KV to (MIGRATE_URL_HEADER, router-owned). A
+        # registered stream also EMBEDS its token ids in each SSE frame
+        # (kgct_token_ids, stripped by the router before the client) — the
+        # ledger the router replays on mid-stream failover.
+        migrate_url = request.headers.get(MIGRATE_URL_HEADER)
+        embed_tokens = bool(
+            stream and migrate_url and self._handoff_ok
+            and self.role != "prefill"
+            and migrate_url.startswith(("http://", "https://"))
+            and (self.peer_pool is None
+                 or migrate_url.rstrip("/") in self.peer_pool))
+        if embed_tokens:
+            self._migrate_urls[rid] = (migrate_url, list(ids), params)
         # ``complete`` guards the engine-side abort: any early handler exit —
         # asyncio.CancelledError when aiohttp cancels the task on client
         # disconnect, ConnectionResetError mid-SSE-write, any bug — must stop
@@ -787,14 +1237,22 @@ class APIServer:
                 # Emit when there is text, a finish, or logprobs to carry —
                 # the detokenizer may hold text back (partial UTF-8 / stop
                 # candidates) while the chunk's token logprobs still need a
-                # frame (empty-text chunks are valid in OpenAI streams).
-                if delta or finished or (want_lps and chunk.new_token_ids
-                                         and not detok.stopped):
+                # frame (empty-text chunks are valid in OpenAI streams). A
+                # migration-registered stream also emits on bare tokens:
+                # the router's failover ledger must cover every token the
+                # detokenizer consumed, or a token-replay resume would
+                # diverge from the relayed text.
+                if delta or finished or (embed_tokens
+                                         and chunk.new_token_ids) \
+                        or (want_lps and chunk.new_token_ids
+                            and not detok.stopped):
                     reason = ("stop" if detok.stopped
                               else _map_reason(chunk.finish_reason))
                     sb = _stream_body(
                         kind, rid, created, self.model_name, delta,
                         reason if finished else None)
+                    if embed_tokens and chunk.new_token_ids:
+                        sb["kgct_token_ids"] = list(chunk.new_token_ids)
                     if want_lps and not detok.stopped:
                         # Stop-string chunks are excluded: their trailing
                         # tokens are not part of the emitted text (see
@@ -815,7 +1273,19 @@ class APIServer:
         except ValueError as e:
             complete = True
             await resp.write(_sse({"error": {"message": str(e), "code": 400}}))
+        except StreamMigratedError as e:
+            # The drain driver pushed this sequence to a peer: abort the
+            # client connection WITHOUT a terminal frame. The router's
+            # relay sees an incomplete stream and re-dispatches to the
+            # migration target, where the parked state resumes the stream
+            # the client is still holding open.
+            complete = True      # engine state is already retired
+            self.engine.engine.obs.tracer.emit(
+                "migrate", rid, side="push", outcome="relay_severed",
+                peer=e.peer_url, tokens=n_out)
+            raise
         finally:
+            self._migrate_urls.pop(rid, None)
             # Release first (see the non-stream path): a reservation that
             # generate() never consumed means nothing reached the engine —
             # aborting would poison a later request reusing the same id.
@@ -1041,14 +1511,15 @@ def _error(status: int, message: str) -> web.Response:
 def build_server(config: EngineConfig, tokenizer_path: Optional[str] = None,
                  model_name: Optional[str] = None, params=None,
                  mesh=None, leader=None, role: str = "both",
-                 prefill_pool: Optional[list] = None) -> APIServer:
+                 prefill_pool: Optional[list] = None,
+                 peer_pool: Optional[list] = None) -> APIServer:
     tokenizer = load_tokenizer(tokenizer_path)
     engine = AsyncLLMEngine(config, params=params,
                             eos_token_id=tokenizer.eos_token_id, mesh=mesh,
                             leader=leader)
     return APIServer(engine, tokenizer, model_name or config.model.name,
                      resilience=config.resilience, role=role,
-                     prefill_pool=prefill_pool)
+                     prefill_pool=prefill_pool, peer_pool=peer_pool)
 
 
 def main(argv: Optional[list[str]] = None) -> None:
@@ -1154,6 +1625,21 @@ def main(argv: Optional[list[str]] = None) -> None:
                    "naming any OTHER url degrades to local recompute (SSRF "
                    "guard for direct-to-pod traffic). Unset = any url "
                    "(single-tenant network)")
+    p.add_argument("--peer-pool", default=None,
+                   help="comma-separated sibling-replica base URLs the "
+                   "SIGTERM drain may live-migrate running streams to; an "
+                   "x-kgct-migrate-url naming any OTHER url keeps the "
+                   "stream local, wait-it-out style (SSRF guard, mirror of "
+                   "--prefill-pool). Unset = any url (single-tenant "
+                   "network)")
+    p.add_argument("--drain-grace-s", type=float, default=None,
+                   help="SIGTERM drain: max seconds to wait for in-flight "
+                   "requests before exiting anyway (default 120). With "
+                   "live migration (--peer-pool / router-named targets) "
+                   "drain is transfer-bound and this is the wait-it-out "
+                   "FALLBACK bound; the deploy renderer derives it (and "
+                   "terminationGracePeriodSeconds) from "
+                   "migrationBudgetSeconds")
     p.add_argument("--enforce-eager", action="store_true",
                    help="disable jit compile caching (debug; always slower)")
     p.add_argument("--trust-remote-code", action="store_true",
@@ -1212,6 +1698,9 @@ def main(argv: Optional[list[str]] = None) -> None:
                                 pp=args.pipeline_parallel_size,
                                 sp=args.sequence_parallel_size,
                                 ep=args.expert_parallel_size),
+        resilience=(ResilienceConfig(drain_grace_s=args.drain_grace_s)
+                    if args.drain_grace_s is not None
+                    else ResilienceConfig()),
         max_model_len=args.max_model_len,
         enforce_eager=args.enforce_eager)
     if args.expert_parallel_size > 1 and not model_cfg.is_moe:
@@ -1265,7 +1754,11 @@ def main(argv: Optional[list[str]] = None) -> None:
                           prefill_pool=([u.strip() for u in
                                          args.prefill_pool.split(",")
                                          if u.strip()]
-                                        if args.prefill_pool else None))
+                                        if args.prefill_pool else None),
+                          peer_pool=([u.strip() for u in
+                                      args.peer_pool.split(",")
+                                      if u.strip()]
+                                     if args.peer_pool else None))
     app = server.build_app()
 
     async def _arm_sigterm(app_):

@@ -72,6 +72,10 @@ class AsyncLLMEngine:
         # lockstep (followers would never see the import).
         self._handoffs: dict[str, dict] = {}
         self._holds: set = set()
+        # Mid-stream failover: already-relayed output token ids to replay
+        # as forced context when an entry is admitted WITHOUT (or after a
+        # failed) KV import — the recompute rung of the resume ladder.
+        self._resumes: dict[str, list] = {}
         # Backdated arrival stamps (time.monotonic) for requests whose
         # handoff pull FAILED before admission: the burned pull wait is
         # client-observed TTFT and must reach the histogram/SLO window.
@@ -159,7 +163,8 @@ class AsyncLLMEngine:
     async def generate(self, request_id: str, prompt_token_ids: list[int],
                        params: SamplingParams, handoff: dict = None,
                        hold_kv: bool = False,
-                       arrival_t0: Optional[float] = None
+                       arrival_t0: Optional[float] = None,
+                       resume_outputs: Optional[list] = None
                        ) -> AsyncIterator[StreamChunk]:
         """Submit a request and yield StreamChunks until finished.
 
@@ -176,7 +181,15 @@ class AsyncLLMEngine:
         ``hold_kv`` marks a prefill-replica request whose finished KV the
         export seam collects (run_in_worker -> engine.export_held). Both
         are ignored under a multihost leader: import/hold on rank 0 alone
-        would desynchronize the SPMD lockstep."""
+        would desynchronize the SPMD lockstep.
+
+        ``resume_outputs``: mid-stream failover — output tokens a dead
+        replica already relayed, replayed as forced context when the entry
+        admits WITHOUT a usable ``handoff`` (none parked, or the import
+        failed): the engine pre-seeds them as output history and the
+        stream carries only genuinely new tokens. With ``handoff`` set,
+        this is the import's fallback rung — a plain re-prefill of the
+        prompt alone would re-emit every already-relayed token."""
         if request_id in self._reserved:
             # Consume the slot reserve_request_id claimed for us.
             self._reserved.discard(request_id)
@@ -196,6 +209,8 @@ class AsyncLLMEngine:
                     self._holds.add(request_id)
                 if arrival_t0 is not None:
                     self._arrival_t0s[request_id] = arrival_t0
+                if resume_outputs:
+                    self._resumes[request_id] = list(resume_outputs)
             self._inbox.append((request_id, prompt_token_ids, params))
             self._cv.notify()
         try:
@@ -213,6 +228,14 @@ class AsyncLLMEngine:
         with self._cv:
             self._aborts.append(request_id)
             self._cv.notify()
+
+    def post_exception(self, request_id: str, exc: Exception) -> None:
+        """Fail a live stream's consumer with ``exc`` (thread-safe; no-op
+        when the queue is gone). The drain-migration driver uses it to
+        abort a client connection AFTER its sequence was pushed to a peer
+        — the broken relay is the router's failover signal — without
+        touching engine state (the export already retired the sequence)."""
+        self._post_exc(request_id, exc)
 
     def run_in_worker(self, fn):
         """Awaitable execution of ``fn(engine)`` on the worker thread —
@@ -293,6 +316,7 @@ class AsyncLLMEngine:
                 self._handoffs.pop(rid, None)
                 self._holds.discard(rid)
                 self._arrival_t0s.pop(rid, None)
+                self._resumes.pop(rid, None)
             if self.leader is not None:
                 # Replicate this iteration's events to follower ranks BEFORE
                 # stepping: their engines apply the same events and step
@@ -342,6 +366,7 @@ class AsyncLLMEngine:
             for rid, ids, params in inbox:
                 handoff = self._handoffs.pop(rid, None)
                 arrival_t0 = self._arrival_t0s.pop(rid, None)
+                resume_outputs = self._resumes.pop(rid, None)
                 hold = rid in self._holds
                 self._holds.discard(rid)
                 try:
@@ -368,12 +393,17 @@ class AsyncLLMEngine:
                                 outcome="import_fallback", error=str(e))
                             if self.on_import_fallback is not None:
                                 try:
-                                    self.on_import_fallback()
+                                    # rid lets the serving layer attribute
+                                    # a MID-STREAM resume import (token-
+                                    # replay rung) separately from a
+                                    # disagg prefill re-run.
+                                    self.on_import_fallback(rid)
                                 except Exception:
                                     logger.exception(
                                         "import-fallback hook failed")
                     self.engine.add_request(rid, ids, params, hold_kv=hold,
-                                            arrival_t0=arrival_t0)
+                                            arrival_t0=arrival_t0,
+                                            resume_outputs=resume_outputs)
                 except ValueError as e:   # oversized prompt etc.
                     self._post_exc(rid, e)
             if self.engine.has_unfinished_requests():

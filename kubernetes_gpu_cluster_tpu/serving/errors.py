@@ -32,6 +32,32 @@ REQUEST_ID_HEADER = "x-kgct-request-id"
 # url is never fetched — the request degrades to local recompute.
 PREFILL_URL_HEADER = "x-kgct-prefill-url"
 
+# Session survivability: the router names the healthy peer a draining
+# replica should PUSH each running sequence's KV to (live migration on
+# SIGTERM) — the ring successor of the serving replica, so the router's
+# own mid-stream failover re-dispatch finds the parked state where it
+# lands. Router-set like the prefill url (client values stripped at the
+# proxy; ``--peer-pool`` is the direct-to-pod allowlist).
+MIGRATE_URL_HEADER = "x-kgct-migrate-url"
+
+# Echoed by ``POST /internal/resume``: how the resumed stream was
+# reconstructed — "import" (parked migrated KV scattered in, decode
+# resumes directly) or "recompute" (token-replay re-prefill). The router
+# attributes kgct_failovers_total{outcome=} from it.
+RESUME_MODE_HEADER = "x-kgct-resume-mode"
+
+
+class StreamMigratedError(Exception):
+    """Posted into a live stream's output queue when its sequence was
+    live-migrated to a peer (drain): the handler aborts the client
+    connection WITHOUT a terminal SSE frame, so the router's relay sees an
+    incomplete stream and re-dispatches to the migration target. Carries
+    the peer url for logs/traces."""
+
+    def __init__(self, peer_url: str):
+        super().__init__(f"stream migrated to {peer_url}")
+        self.peer_url = peer_url
+
 # Ids must be safe to echo into headers, log records, and trace JSON: a
 # bounded charset, no whitespace/control bytes, bounded length. Anything
 # else is treated as absent and a fresh id is minted.

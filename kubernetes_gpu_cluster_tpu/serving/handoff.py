@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import json
 import struct
+import time
+from typing import Optional
 
 import aiohttp
 import numpy as np
@@ -130,6 +132,85 @@ def handoff_request_body(prompt_token_ids: list, body: dict) -> dict:
         if field in body and body[field] is not None:
             fwd[field] = body[field]
     return fwd
+
+
+# Wall bound for one mid-stream migration PUSH (connect + transfer). Much
+# tighter than the pull bound: the blob is already in host memory — no
+# prefill compute hides inside it — and every second here extends the
+# drain. A push that misses the bound falls back to wait-it-out.
+MIGRATE_PUSH_TIMEOUT_S = 20.0
+
+# Parked-migration bounds: a receiving replica holds at most this many
+# mid-stream states, each for at most this long, before the router's
+# failover re-dispatch claims it (or never comes — client gone).
+MIGRATION_PARK_CAP = 64
+MIGRATION_PARK_TTL_S = 120.0
+
+
+class MigrationStore:
+    """Bounded parking lot for pushed mid-stream migration states on the
+    RECEIVING replica: a drain push parks the decoded state dict here (host
+    memory only — no device pages are spent on a stream whose client may
+    never fail over); the router's ``/internal/resume`` re-dispatch claims
+    it by request id and imports it then. Entries expire by TTL and the
+    store is capacity-bounded (oldest evicted first) so a misbehaving or
+    crashing fleet cannot balloon a healthy replica. Engine-free and
+    jax-free, like the codec."""
+
+    def __init__(self, cap: int = MIGRATION_PARK_CAP,
+                 ttl_s: float = MIGRATION_PARK_TTL_S,
+                 clock=None):
+        self.cap = cap
+        self.ttl_s = ttl_s
+        self._clock = clock if clock is not None else time.monotonic
+        self._entries: dict[str, tuple[float, dict]] = {}
+
+    def __len__(self) -> int:
+        self._expire()
+        return len(self._entries)
+
+    def _expire(self) -> None:
+        now = self._clock()
+        dead = [rid for rid, (deadline, _) in self._entries.items()
+                if deadline <= now]
+        for rid in dead:
+            del self._entries[rid]
+
+    def put(self, request_id: str, state: dict) -> None:
+        self._expire()
+        # A re-push for the same id replaces (the newer snapshot wins);
+        # otherwise evict oldest-deadline entries to stay under cap.
+        self._entries.pop(request_id, None)
+        while len(self._entries) >= self.cap:
+            oldest = min(self._entries, key=lambda r: self._entries[r][0])
+            del self._entries[oldest]
+        self._entries[request_id] = (self._clock() + self.ttl_s, state)
+
+    def pop(self, request_id: str) -> Optional[dict]:
+        self._expire()
+        entry = self._entries.pop(request_id, None)
+        return entry[1] if entry is not None else None
+
+
+async def push_handoff(session: aiohttp.ClientSession, peer_url: str,
+                       blob, request_id: str,
+                       timeout_s: float = MIGRATE_PUSH_TIMEOUT_S) -> None:
+    """POST a mid-stream migration blob to ``peer_url``'s
+    ``/internal/kv_handoff`` (the push direction of the same endpoint the
+    disaggregated pull uses; the octet-stream content type selects it).
+    Raises on any non-200 or timeout — the caller falls back to keeping
+    the sequence local (wait-it-out drain)."""
+    async with session.post(
+            f"{peer_url.rstrip('/')}/internal/kv_handoff", data=blob,
+            headers={REQUEST_ID_HEADER: request_id,
+                     "Content-Type": "application/octet-stream"},
+            timeout=aiohttp.ClientTimeout(total=timeout_s)) as resp:
+        if resp.status != 200:
+            snippet = (await resp.content.read(2048)).decode(
+                "utf-8", errors="replace")
+            raise RuntimeError(
+                f"migration push rejected {resp.status}: {snippet[:200]}")
+        await resp.read()
 
 
 async def fetch_handoff(session: aiohttp.ClientSession, prefill_url: str,

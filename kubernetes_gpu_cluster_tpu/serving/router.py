@@ -58,7 +58,20 @@ the circuit-breaking/retry machinery unchanged.
 Chaos sites (resilience.faults): ``router_connect`` simulates a connect
 failure on the picked replica, ``replica_hang`` a mid-stream read timeout,
 ``replica_down`` forces the health probe of replica index ``value`` to
-fail (drain/death remap of ring-owned keys).
+fail (drain/death remap of ring-owned keys), ``replica_kill_midstream``
+severs the upstream socket after N relayed chunks (mid-stream failover /
+resume ladder).
+
+Session survivability: on a migration-capable fleet (>1 replica) the
+router names each SSE completion's drain-push target in
+MIGRATE_URL_HEADER (the key's ring successor), parses the relay to keep
+the replica-embedded token ledger (stripped before the client), and when
+the upstream dies before ``[DONE]`` re-dispatches to ring successors via
+``POST /internal/resume`` — parked-KV import where the dying replica
+managed a push, token replay otherwise — splicing the resumed stream so
+the client sees ONE uninterrupted response; bounded attempts end in a
+clean truncated-stream error frame carrying the request id
+(``kgct_failovers_total{outcome=}``, ``kgct_router_failover_seconds``).
 
 In-cluster, replica discovery is the headless-Service DNS name; static URLs
 work for local/dev. Deployment manifests are rendered by
@@ -83,13 +96,15 @@ import aiohttp
 from aiohttp import web
 
 from ..observability.flightrecorder import FlightRecorder
+from ..observability.prometheus import Histogram
 from ..observability.trace import RequestTracer, merge_perfetto
 from ..resilience.faults import get_injector as _get_injector
 from ..resilience.faults import inject as _inject_fault
 from ..utils import get_logger
 # The engine's shed/drain responses use the same envelope (serving.errors):
 # a router-level 503 is handled by the identical client code path.
-from .errors import (PREFILL_URL_HEADER, REQUEST_ID_HEADER,
+from .errors import (MIGRATE_URL_HEADER, PREFILL_URL_HEADER,
+                     REQUEST_ID_HEADER, RESUME_MODE_HEADER,
                      valid_request_id)
 from .errors import overloaded_error as _proxy_error
 
@@ -113,6 +128,73 @@ HOP_HEADERS = {"transfer-encoding", "content-length", "connection",
 # the balance property test) while the ring stays tiny (N*64 bisect points);
 # the CHWBL load bound — not vnode count — is what bounds actual load skew.
 RING_VNODES = 64
+
+# Mid-stream failover: how many ring successors a broken SSE relay may be
+# re-dispatched to (POST /internal/resume) before the client gets the
+# truncated-stream error. Small on purpose — each attempt re-prefills in
+# the worst (token-replay) case.
+FAILOVER_ATTEMPTS = 2
+
+
+class _SSERelay:
+    """Incremental SSE frame parser for migration-capable stream relays.
+
+    The replica embeds each frame's new token ids under ``kgct_token_ids``
+    (opted in by the MIGRATE_URL_HEADER the router itself sets); this
+    parser strips the field before the bytes reach the client and keeps
+    the running token ledger — exactly what a mid-stream failover replays
+    to a ring successor. Frames without the field pass through
+    byte-identical; a partial frame at the moment of upstream death stays
+    in the buffer and never reaches the client, so the ledger always
+    matches the delivered text."""
+
+    def __init__(self):
+        self._buf = b""
+        self.tokens: list[int] = []
+        self.done = False          # saw the terminal [DONE] frame
+        self.finished = False      # saw a finish_reason-stamped frame: the
+                                   # completion is semantically complete
+                                   # even if [DONE] never arrives
+        self.frames = 0
+
+    def reset_buffer(self) -> None:
+        """Drop a dead upstream's partial frame before splicing a resumed
+        stream in — stale bytes would corrupt the next upstream's framing.
+        The token ledger survives: it covers only fully-relayed frames."""
+        self._buf = b""
+
+    def feed(self, chunk: bytes) -> bytes:
+        self._buf += chunk
+        out = []
+        while b"\n\n" in self._buf:
+            frame, self._buf = self._buf.split(b"\n\n", 1)
+            out.append(self._render(frame))
+        return b"".join(out)
+
+    def _render(self, frame: bytes) -> bytes:
+        data_lines = [l for l in frame.split(b"\n")
+                      if l.startswith(b"data:")]
+        payload = b"\n".join(l[5:].strip() for l in data_lines)
+        if payload == b"[DONE]":
+            self.done = True
+            return frame + b"\n\n"
+        try:
+            obj = json.loads(payload)
+        except ValueError:
+            return frame + b"\n\n"
+        self.frames += 1
+        if isinstance(obj, dict):
+            try:
+                if obj["choices"][0].get("finish_reason"):
+                    self.finished = True
+            except (LookupError, AttributeError, TypeError):
+                pass
+        if isinstance(obj, dict) and "kgct_token_ids" in obj:
+            toks = obj.pop("kgct_token_ids")
+            if isinstance(toks, list):
+                self.tokens.extend(int(t) for t in toks)
+            return b"data: " + json.dumps(obj).encode() + b"\n\n"
+        return frame + b"\n\n"
 
 
 def _stable_hash(data: bytes) -> int:
@@ -195,7 +277,8 @@ class Router:
                  balance_factor: float = 1.5,
                  ring_vnodes: int = RING_VNODES,
                  trace_timeout_s: float = 5.0,
-                 prefill_urls: Optional[list[str]] = None):
+                 prefill_urls: Optional[list[str]] = None,
+                 failover_attempts: int = FAILOVER_ATTEMPTS):
         if routing_policy not in ("least-inflight", "prefix-affinity"):
             raise ValueError(f"unknown routing_policy {routing_policy!r} "
                              "(known: least-inflight, prefix-affinity)")
@@ -251,6 +334,17 @@ class Router:
         self.bench_cooldown_s = bench_cooldown_s
         self.retries_total = 0
         self.scrape_errors_total = 0
+        # Mid-stream failover accounting: outcome "import" (the successor
+        # resumed from a parked migration push), "recompute" (token-replay
+        # re-prefill), "failed" (every rung exhausted — the client got the
+        # truncated-stream error). Pre-seeded so a fresh scrape renders
+        # zeros, never absent series.
+        self.failover_attempts = failover_attempts
+        self.failovers_total: dict[str, int] = {
+            "import": 0, "recompute": 0, "failed": 0}
+        self.failover_latency = Histogram(
+            "kgct_router_failover_seconds",
+            "upstream death to resumed-stream first byte")
         # Fleet tracing: the router's own span stream (pick / connect_retry
         # / ttfb / relay per request id) mirrored into the black-box flight
         # recorder; /debug/trace merges it with replica traces. Bounded
@@ -420,6 +514,10 @@ class Router:
                   f'role="{role}"}} {r.inflight}' for r, role in pools]
         lines += ["# TYPE kgct_router_retries_total counter",
                   f"kgct_router_retries_total {self.retries_total}"]
+        lines.append("# TYPE kgct_failovers_total counter")
+        lines += [f'kgct_failovers_total{{outcome="{oc}"}} {n}'
+                  for oc, n in sorted(self.failovers_total.items())]
+        lines += self.failover_latency.render()
         # Routing-policy surface: which policy is live (info-style gauge)
         # plus the affinity accounting. All zeros-safe — a fresh scrape of a
         # least-inflight router renders every series with 0, never nan/absent
@@ -776,8 +874,18 @@ class Router:
         disagg_post = bool(self.prefill_replicas
                            and request.method == "POST"
                            and request.path.endswith("/completions"))
+        # Session survivability needs the parsed body too (stream flag +
+        # the resume re-dispatch payload) whenever the fleet has a peer a
+        # stream could fail over to. The byte-level pre-filter keeps the
+        # common non-streaming request off the json.loads hot path: only
+        # streams fail over, and a body without the key cannot be one.
+        survivable_post = bool(len(self.replicas) > 1
+                               and request.method == "POST"
+                               and request.path.endswith("/completions")
+                               and b'"stream"' in body)
         obj = self._parse_json_dict(body) \
-            if (self.routing_policy == "prefix-affinity" or disagg_post) \
+            if (self.routing_policy == "prefix-affinity" or disagg_post
+                or survivable_post) \
             else None
         akey = self._affinity_key_from_obj(obj) \
             if self.routing_policy == "prefix-affinity" else None
@@ -799,7 +907,8 @@ class Router:
                 self.tracer.emit("pick", rid, replica=pr.url,
                                  pool="prefill", **pf_info)
         if pr is None:
-            return await self._forward(request, body, rid, akey, None)
+            return await self._forward(request, body, rid, akey, None,
+                                       obj=obj)
         # The handoff pull slot is outstanding on this prefill replica for
         # the request's lifetime — without the count the prefill pool's
         # bounded-load overflow could never trigger (every prefill Replica
@@ -810,20 +919,50 @@ class Router:
         # MORE eager under pile-up — the safe direction.
         pr.inflight += 1
         try:
-            return await self._forward(request, body, rid, akey, pr.url)
+            return await self._forward(request, body, rid, akey, pr.url,
+                                       obj=obj)
         finally:
             pr.inflight -= 1
 
+    def _ring_successor(self, key: bytes, exclude: set) -> Optional[str]:
+        """First healthy main-pool replica on the ring walk from ``key``
+        that is not in ``exclude`` — the deterministic migrate-push /
+        failover target. The draining replica pushes a stream's KV to this
+        URL (the router names it in MIGRATE_URL_HEADER at dispatch), and
+        the failover re-dispatch walks the SAME ring, so the resume lands
+        where the parked state lives."""
+        byurl = {r.url: r for r in self.replicas}
+        for url in self.ring.walk(key):
+            replica = byurl.get(url)
+            if replica is not None and replica.healthy \
+                    and url not in exclude:
+                return url
+        return None
+
     async def _forward(self, request: web.Request, body: bytes, rid: str,
                        akey: Optional[bytes],
-                       prefill_hdr: Optional[str]) -> web.StreamResponse:
+                       prefill_hdr: Optional[str],
+                       obj: Optional[dict] = None) -> web.StreamResponse:
         """The failover forwarding loop of :meth:`proxy`, split out so the
         prefill-slot accounting brackets it in one try/finally whatever
-        path it returns through."""
+        path it returns through. ``obj`` (the parsed body) enables
+        MID-STREAM failover for SSE completions: the relay parses frames
+        (stripping the replica's kgct_token_ids ledger), and an upstream
+        that dies before [DONE] is transparently re-dispatched to a ring
+        successor via /internal/resume with the relayed tokens as forced
+        context — the client sees one uninterrupted stream."""
         tried: set[str] = set()
         last_err: Optional[Exception] = None
         connect_failed = False
         rounds = 0
+        # Failover key: the affinity key when one exists (the same walk
+        # the pick used), else a request-id-derived key — deterministic
+        # either way, so push target and failover target agree.
+        mig_key = akey if akey is not None else f"failover:{rid}".encode()
+        failover_ok = bool(len(self.replicas) > 1 and isinstance(obj, dict)
+                           and obj.get("stream")
+                           and request.method == "POST"
+                           and request.path.endswith("/completions"))
         while True:
             # Retry rounds (rounds > 0) ignore the healthy flag: the connect
             # failures that triggered the retry are exactly what benched the
@@ -865,7 +1004,8 @@ class Router:
                         k: v for k, v in request.headers.items()
                         if k.lower() not in HOP_HEADERS
                         and k.lower() not in (REQUEST_ID_HEADER,
-                                              PREFILL_URL_HEADER)}
+                                              PREFILL_URL_HEADER,
+                                              MIGRATE_URL_HEADER)}
                     # The replica adopts this as its engine request id, so
                     # its lifecycle trace correlates with the router spans.
                     fwd_headers[REQUEST_ID_HEADER] = rid
@@ -873,6 +1013,18 @@ class Router:
                         # Router-owned (client values stripped above): the
                         # decode replica pulls prefilled KV from here.
                         fwd_headers[PREFILL_URL_HEADER] = prefill_hdr
+                    mig_url = None
+                    if failover_ok:
+                        # Name the drain-push target (ring successor of the
+                        # serving replica): a SIGTERM on the upstream
+                        # live-migrates this stream's KV there, and our own
+                        # failover walk below re-dispatches to the same
+                        # place. Header presence also opts the replica into
+                        # embedding the per-frame token ledger.
+                        mig_url = self._ring_successor(mig_key,
+                                                       {replica.url})
+                        if mig_url is not None:
+                            fwd_headers[MIGRATE_URL_HEADER] = mig_url
                     t_attempt = time.monotonic()
                     upstream_cm = self._session.request(
                         request.method, f"{replica.url}{request.path_qs}",
@@ -920,11 +1072,31 @@ class Router:
                         resp.headers[REQUEST_ID_HEADER] = rid
                     await resp.prepare(request)
                     relayed = 0
+                    # Parse-mode relay: a migration-capable SSE stream is
+                    # framed so the token ledger can be kept (and stripped)
+                    # and truncation-before-[DONE] detected; everything
+                    # else relays raw chunks, byte-identical to before.
+                    relay = None
+                    if (mig_url is not None and upstream.status == 200
+                            and upstream.headers.get(
+                                "Content-Type", "").startswith(
+                                "text/event-stream")):
+                        relay = _SSERelay()
                     while True:
                         try:
                             if _inject_fault("replica_hang"):
                                 raise asyncio.TimeoutError(
                                     "KGCT_FAULT replica_hang")
+                            if relay is not None and _inject_fault(
+                                    "replica_kill_midstream"):
+                                # Chaos: the upstream socket is severed
+                                # after N relayed chunks (rule param
+                                # ``after``) — the deterministic
+                                # mid-stream death the failover exists
+                                # for.
+                                raise aiohttp.ClientPayloadError(
+                                    "KGCT_FAULT replica_kill_midstream: "
+                                    "upstream socket severed")
                             # Per-chunk stall deadline: once streaming, a
                             # healthy engine emits tokens continuously —
                             # stall_timeout_s of silence means the replica
@@ -934,12 +1106,19 @@ class Router:
                                 self.stall_timeout_s)
                         except (aiohttp.ClientError,
                                 asyncio.TimeoutError) as e:
-                            # Upstream died or stalled mid-stream (no bytes
-                            # for stall_timeout_s): circuit-break the
-                            # replica; the client stream is already
-                            # committed — terminate it (truncation is the
-                            # signal).
+                            # Upstream died or stalled mid-stream:
+                            # circuit-break the replica. A migration-
+                            # capable stream re-dispatches to a ring
+                            # successor (the resume ladder); otherwise the
+                            # committed client stream is terminated
+                            # (truncation is the signal).
                             self._count_failure(replica, e, request_id=rid)
+                            if relay is not None and not relay.done:
+                                upstream.close()
+                                await self._failover_midstream(
+                                    request, resp, rid, obj, relay,
+                                    mig_key, {replica.url}, err=e)
+                                return resp
                             self.tracer.emit("abort", rid,
                                              reason="upstream_stall",
                                              error=str(e), bytes=relayed)
@@ -947,10 +1126,26 @@ class Router:
                                 await resp.write_eof()
                             return resp
                         if not chunk:
+                            if relay is not None and not relay.done:
+                                # EOF before [DONE]: a drain severed the
+                                # relay after pushing the stream's KV (or
+                                # the replica died cleanly) — same resume
+                                # ladder as an errored read.
+                                err = RuntimeError(
+                                    "upstream stream ended before [DONE]")
+                                self._count_failure(replica, err,
+                                                    request_id=rid)
+                                upstream.close()
+                                await self._failover_midstream(
+                                    request, resp, rid, obj, relay,
+                                    mig_key, {replica.url}, err=err)
+                                return resp
                             break
+                        out = chunk if relay is None else relay.feed(chunk)
                         try:
-                            await resp.write(chunk)
-                            relayed += len(chunk)
+                            if out:
+                                await resp.write(out)
+                                relayed += len(out)
                         except (ConnectionError, aiohttp.ClientError):
                             # CLIENT went away — not the replica's fault; no
                             # failure accounting.
@@ -984,6 +1179,164 @@ class Router:
             retry_after_s=max(int(self.health_interval_s), 1))
         resp.headers[REQUEST_ID_HEADER] = rid
         return resp
+
+    async def _failover_midstream(self, request: web.Request,
+                                  resp: web.StreamResponse, rid: str,
+                                  obj: dict, relay: _SSERelay,
+                                  key: bytes, exclude: set,
+                                  err: Optional[Exception] = None) -> bool:
+        """Transparent mid-stream failover: re-dispatch a broken SSE relay
+        to ring successors via ``POST /internal/resume`` (original body +
+        the relayed-token ledger) and splice the resumed stream onto the
+        already-committed client response. Bounded attempts; every rung
+        exhausted ends the stream with a CLEAN truncated-stream error
+        frame carrying the request id — degraded, attributed, never a
+        hang. Returns True when the client-visible stream completed."""
+        t0 = time.monotonic()
+        exclude = set(exclude)
+        if relay.finished:
+            # The upstream died in the gap between its final
+            # finish_reason-stamped frame and the [DONE] trailer: the
+            # client already holds a complete completion — close it
+            # cleanly instead of re-dispatching (every resume would 400
+            # with nothing left to generate) and appending a spurious
+            # truncation error to a finished stream.
+            self.tracer.emit("failover", rid, outcome="already_complete",
+                             tokens=len(relay.tokens))
+            with contextlib.suppress(Exception):
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+            return True
+        kind = ("chat.completion" if "chat" in request.path
+                else "completion")
+        self.tracer.emit("failover", rid, error=str(err)[:200] if err
+                         else "", relayed_tokens=len(relay.tokens))
+        attempts = 0
+        while attempts < self.failover_attempts:
+            target_url = self._ring_successor(key, exclude)
+            if target_url is None:
+                break
+            attempts += 1
+            exclude.add(target_url)
+            target = next(r for r in self.replicas if r.url == target_url)
+            headers = {REQUEST_ID_HEADER: rid}
+            nxt = self._ring_successor(key, exclude)
+            if nxt is not None:
+                # The resumed stream is itself survivable: name ITS
+                # drain-push target so a second drain walks on.
+                headers[MIGRATE_URL_HEADER] = nxt
+            payload = {"body": obj, "kind": kind,
+                       "relayed_token_ids": list(relay.tokens)}
+            relay.reset_buffer()
+            target.inflight += 1
+            try:
+                resume_cm = self._session.post(
+                    f"{target_url}/internal/resume", json=payload,
+                    headers=headers)
+                upstream = await asyncio.wait_for(
+                    resume_cm.__aenter__(), self.response_timeout_s)
+                try:
+                    if upstream.status != 200:
+                        snippet = (await upstream.content.read(2048)
+                                   ).decode("utf-8", errors="replace")
+                        if (upstream.status == 400
+                                and "nothing to resume" in snippet):
+                            # The successor's engine confirms the replayed
+                            # history already satisfies a stop condition
+                            # (a finish the relay could not see): the
+                            # stream is complete, not failed.
+                            self.tracer.emit("failover", rid,
+                                             replica=target_url,
+                                             outcome="already_complete",
+                                             tokens=len(relay.tokens))
+                            with contextlib.suppress(Exception):
+                                await resp.write(b"data: [DONE]\n\n")
+                                await resp.write_eof()
+                            return True
+                        self.tracer.emit(
+                            "failover", rid, replica=target_url,
+                            attempt=attempts,
+                            error=f"resume {upstream.status}: "
+                                  f"{snippet[:120]}")
+                        continue
+                    mode = upstream.headers.get(RESUME_MODE_HEADER,
+                                                "recompute")
+                    self.failover_latency.observe(time.monotonic() - t0)
+                    while True:
+                        try:
+                            chunk = await asyncio.wait_for(
+                                upstream.content.readany(),
+                                self.stall_timeout_s)
+                        except (aiohttp.ClientError,
+                                asyncio.TimeoutError) as e2:
+                            # The successor died too: walk on.
+                            self._count_failure(target, e2,
+                                                request_id=rid)
+                            self.tracer.emit("failover", rid,
+                                             replica=target_url,
+                                             attempt=attempts,
+                                             error=str(e2)[:200])
+                            relay.reset_buffer()
+                            break
+                        if not chunk:
+                            break
+                        out = relay.feed(chunk)
+                        try:
+                            if out:
+                                await resp.write(out)
+                        except (ConnectionError, aiohttp.ClientError):
+                            self.tracer.emit("abort", rid,
+                                             reason="client_disconnect")
+                            return True     # client gone; stop here
+                    if relay.done:
+                        outcome = ("import" if mode == "import"
+                                   else "recompute")
+                        self.failovers_total[outcome] = (
+                            self.failovers_total.get(outcome, 0) + 1)
+                        self.tracer.emit("failover", rid,
+                                         replica=target_url,
+                                         attempt=attempts, outcome=outcome,
+                                         tokens=len(relay.tokens))
+                        self.flight.dump("midstream_failover",
+                                         request_id=rid, outcome=outcome,
+                                         replica=target_url,
+                                         attempts=attempts)
+                        with contextlib.suppress(Exception):
+                            await resp.write_eof()
+                        return True
+                finally:
+                    with contextlib.suppress(Exception):
+                        await resume_cm.__aexit__(None, None, None)
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e2:
+                self._count_failure(target, e2, request_id=rid)
+                self.tracer.emit("failover", rid, replica=target_url,
+                                 attempt=attempts, error=str(e2)[:200])
+                continue
+            finally:
+                target.inflight -= 1
+        # Resume impossible: close the ladder LOUDLY — an explicit error
+        # frame with the request id, then a clean stream end (a silent
+        # truncation would read as a finished completion).
+        self.failovers_total["failed"] = (
+            self.failovers_total.get("failed", 0) + 1)
+        self.tracer.emit("failover", rid, outcome="failed",
+                         attempts=attempts, tokens=len(relay.tokens))
+        self.flight.dump("midstream_failover", request_id=rid,
+                         outcome="failed", attempts=attempts)
+        logger.warning("mid-stream failover failed after %d attempt(s); "
+                       "truncating the stream", attempts,
+                       extra={"request_id": rid})
+        err_body = {"error": {
+            "message": ("stream truncated: the serving replica died "
+                        "mid-stream and resume failed after "
+                        f"{attempts} attempt(s)"),
+            "type": "upstream_error", "code": 502, "request_id": rid}}
+        with contextlib.suppress(Exception):
+            await resp.write(b"data: " + json.dumps(err_body).encode()
+                             + b"\n\n")
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        return False
 
     def _count_failure(self, replica: Replica, err: Exception,
                        request_id: str = "") -> None:

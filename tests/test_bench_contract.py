@@ -298,3 +298,43 @@ class TestRouterPhaseContract:
     def test_absent_phase_yields_null_headline(self):
         out = bench.assemble_output(_fake_results(), "cpu")
         assert out["router_affinity_warm_over_li_ttft"] is None
+
+
+class TestDrainPhaseContract:
+    """KGCT_BENCH_DRAIN rides the bounded last-line contract like the
+    other phases: headline parseable from the last stdout line, droppable
+    under the byte bound, null when the phase was skipped."""
+
+    def test_headline_parses_in_last_line(self):
+        results = _fake_results()
+        results[-1]["drain"] = {
+            "sessions": 6, "max_new": 48,
+            "wait": {"drain_seconds": 4.1, "complete_streams": 6,
+                     "migrations_push_fallback": 3},
+            "migrate": {"drain_seconds": 1.4, "complete_streams": 6,
+                        "migrations_push_ok": 3,
+                        "failovers": {"import": 3}},
+            "drain_migrate_over_wait_seconds": 0.341,
+        }
+        out = bench.assemble_output(results, "cpu")
+        parsed = bench.parse_result_line(json.dumps(out) + "\n")
+        assert parsed["drain_migrate_over_wait_seconds"] == 0.341
+        assert parsed["configs"][-1]["drain"]["migrate"][
+            "migrations_push_ok"] == 3
+
+    def test_headline_is_droppable_under_the_bound(self):
+        assert ("drain_migrate_over_wait_seconds"
+                in bench._DROPPABLE_HEADLINE)
+        out = bench.assemble_output(_fake_results(), "cpu")
+        line = json.dumps(bench.compact_result(out))
+        assert len(line) <= bench.RESULT_LINE_MAX
+
+    def test_absent_phase_yields_null_headline(self):
+        out = bench.assemble_output(_fake_results(), "cpu")
+        assert out["drain_migrate_over_wait_seconds"] is None
+
+    def test_help_lists_drain_knobs(self):
+        text = bench.build_arg_parser().format_help()
+        for knob in ("KGCT_BENCH_DRAIN", "KGCT_BENCH_DRAIN_SESSIONS",
+                     "KGCT_BENCH_DRAIN_MAX_NEW"):
+            assert knob in text

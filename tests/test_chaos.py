@@ -265,6 +265,95 @@ class TestGracefulDrain:
         server.drain_state = DrainState()
         server.hub.drain = server.drain_state
 
+    def test_migrate_fail_degrades_to_wait_it_out(self, chaos_client):
+        """``migrate_fail`` chaos: the live-migration export raises before
+        the sequence detaches, so THAT stream keeps decoding locally (the
+        pre-migration wait-it-out drain) and still reaches [DONE] — with
+        the fallback attributed in the migration series and a trace span."""
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            MIGRATE_URL_HEADER)
+        loop, client, server = chaos_client
+
+        async def go():
+            configure_faults("migrate_fail")
+            r = await client.post("/v1/completions", json={
+                "prompt": "migrate me", "max_tokens": 16,
+                "temperature": 0.0, "stream": True},
+                headers={MIGRATE_URL_HEADER: "http://127.0.0.1:1"})
+            assert r.status == 200
+            it = r.content.__aiter__()
+            await it.__anext__()               # stream demonstrably started
+            task = server.begin_drain()
+            assert task is not None
+            saw_done, saw_error = False, False
+            async for line in r.content:
+                text = line.decode().strip()
+                if text == "data: [DONE]":
+                    saw_done = True
+                elif text.startswith("data:") and '"error"' in text:
+                    saw_error = True
+            assert saw_done and not saw_error, \
+                "migrate_fail must degrade to wait-it-out, not truncate"
+            await asyncio.wait_for(task, timeout=10)
+            assert server.migration.migrations.get(
+                ("push", "fallback"), 0) >= 1
+            assert server.migration.migrations.get(("push", "ok"), 0) == 0
+            events = server.engine.engine.obs.flight.export()["events"]
+            assert any(e["kind"] == "migrate"
+                       and e.get("outcome") == "fallback" for e in events)
+            rm = await client.get("/metrics")
+            text = await rm.text()
+            assert 'kgct_migrations_total{side="push",outcome="fallback"}' \
+                in text
+        loop.run_until_complete(go())
+        server.drain_state = DrainState()
+        server.hub.drain = server.drain_state
+
+    def test_push_failure_reimports_locally(self, chaos_client):
+        """Rung 2 of the push ladder: the export succeeded (the sequence
+        detached) but the peer is unreachable — the snapshot re-imports
+        LOCALLY and the stream resumes here as if never exported,
+        byte-identical to an undrained run."""
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            MIGRATE_URL_HEADER)
+        loop, client, server = chaos_client
+        body = {"prompt": "push me somewhere", "max_tokens": 16,
+                "temperature": 0.0}
+
+        async def go():
+            r = await client.post("/v1/completions", json=body)
+            assert r.status == 200
+            ref = (await r.json())["choices"][0]["text"]
+            r = await client.post(
+                "/v1/completions", json=dict(body, stream=True),
+                headers={MIGRATE_URL_HEADER: "http://127.0.0.1:1"})
+            assert r.status == 200
+            chunks = []
+            it = r.content.__aiter__()
+            chunks.append(await it.__anext__())
+            task = server.begin_drain()
+            assert task is not None
+            async for line in r.content:
+                chunks.append(line)
+            await asyncio.wait_for(task, timeout=10)
+            text, saw_done = [], False
+            for line in chunks:
+                s = line.decode().strip()
+                if s == "data: [DONE]":
+                    saw_done = True
+                elif s.startswith("data:"):
+                    obj = json.loads(s[5:].strip())
+                    assert "error" not in obj, obj
+                    text.append(obj["choices"][0]["text"])
+            assert saw_done
+            assert "".join(text) == ref, \
+                "local re-import must resume byte-identically"
+            assert server.migration.migrations.get(
+                ("push", "fallback"), 0) >= 1
+        loop.run_until_complete(go())
+        server.drain_state = DrainState()
+        server.hub.drain = server.drain_state
+
     def test_sigterm_handler_drives_drain(self):
         import os
         import signal
@@ -295,6 +384,140 @@ class TestGracefulDrain:
                 uninstall()
 
         asyncio.run(scenario())
+
+
+class TestResumeAndRecv:
+    """The session-survivability server seams on the warm module server:
+    /internal/resume reconstructs a relayed stream by token replay
+    (byte-identical continuation, only new tokens emitted), and the
+    migration-push receive direction of /internal/kv_handoff validates
+    before parking."""
+
+    def test_resume_token_replay_emits_only_new_tokens(self, chaos_client):
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            MIGRATE_URL_HEADER, REQUEST_ID_HEADER, RESUME_MODE_HEADER)
+        loop, client, server = chaos_client
+        body = {"prompt": "resume this stream", "max_tokens": 12,
+                "temperature": 0.0}
+
+        async def go():
+            # A migration-registered stream embeds its per-frame token
+            # ledger (what the router keeps, and what a failover replays).
+            r = await client.post(
+                "/v1/completions", json=dict(body, stream=True),
+                headers={MIGRATE_URL_HEADER: "http://127.0.0.1:1"})
+            assert r.status == 200
+            frames = []
+            async for line in r.content:
+                s = line.decode().strip()
+                if s.startswith("data:") and s != "data: [DONE]":
+                    frames.append(json.loads(s[5:].strip()))
+            toks = [t for f in frames for t in f.get("kgct_token_ids", [])]
+            full = "".join(f["choices"][0]["text"] for f in frames)
+            assert len(toks) == 12, "ledger must cover every token"
+            # Replay the first 5 tokens' worth: the resumed stream must
+            # carry ONLY the remainder, byte-identical.
+            cut, prefix = 0, ""
+            for f in frames:
+                if cut >= 5:
+                    break
+                cut += len(f.get("kgct_token_ids", []))
+                prefix += f["choices"][0]["text"]
+            resume = await client.post(
+                "/internal/resume",
+                json={"body": body, "kind": "completion",
+                      "relayed_token_ids": toks[:cut]},
+                headers={REQUEST_ID_HEADER: "resume-replay-1"})
+            assert resume.status == 200, await resume.text()
+            assert resume.headers[RESUME_MODE_HEADER] == "recompute"
+            got, saw_done = [], False
+            async for line in resume.content:
+                s = line.decode().strip()
+                if s == "data: [DONE]":
+                    saw_done = True
+                elif s.startswith("data:"):
+                    obj = json.loads(s[5:].strip())
+                    assert "error" not in obj, obj
+                    got.append(obj["choices"][0]["text"])
+            assert saw_done
+            assert "".join(got) == full[len(prefix):]
+            assert server.migration.migrations.get(
+                ("resume", "fallback"), 0) >= 1
+            events = server.engine.engine.obs.flight.export()["events"]
+            assert any(e["kind"] == "migrate"
+                       and e.get("side") == "resume" for e in events)
+        loop.run_until_complete(go())
+
+    def test_resume_rejects_malformed_envelopes(self, chaos_client):
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            REQUEST_ID_HEADER)
+        loop, client, _ = chaos_client
+
+        async def go():
+            hdr = {REQUEST_ID_HEADER: "resume-bad-1"}
+            r = await client.post("/internal/resume", data=b"not json",
+                                  headers=hdr)
+            assert r.status == 400
+            r = await client.post("/internal/resume", json={
+                "body": "nope", "relayed_token_ids": []}, headers=hdr)
+            assert r.status == 400
+            r = await client.post("/internal/resume", json={
+                "body": {"prompt": "x"},
+                "relayed_token_ids": [1, "two"]}, headers=hdr)
+            assert r.status == 400
+            r = await client.post("/internal/resume", json={
+                "body": {"prompt": "x"}, "relayed_token_ids": [],
+                "kind": "mystery"}, headers=hdr)
+            assert r.status == 400
+        loop.run_until_complete(go())
+
+    def test_recv_validates_before_parking(self, chaos_client):
+        import numpy as np
+
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            REQUEST_ID_HEADER)
+        from kubernetes_gpu_cluster_tpu.serving.handoff import encode_handoff
+        loop, client, server = chaos_client
+
+        def blob(model="debug-tiny", mid_stream=True):
+            k = np.zeros((1, 2, 4, 4), dtype="float32")
+            state = {"model": model, "page_size": 16, "dtype": "float32",
+                     "prompt_token_ids": [1, 2, 3],
+                     "output_token_ids": [7], "output_logprobs": [-0.5],
+                     "output_top_logprobs": [], "k": k, "v": k}
+            if mid_stream:
+                state["mid_stream"] = True
+            return encode_handoff(state)
+
+        async def go():
+            octet = {"Content-Type": "application/octet-stream",
+                     REQUEST_ID_HEADER: "park-1"}
+            errs0 = server.migration.migrations.get(("recv", "error"), 0)
+            # Model mismatch: 409, never parked.
+            r = await client.post("/internal/kv_handoff",
+                                  data=blob(model="llama-3-8b"),
+                                  headers=octet)
+            assert r.status == 409
+            # A held-prefill export is NOT a mid-stream state: 400.
+            r = await client.post("/internal/kv_handoff",
+                                  data=blob(mid_stream=False),
+                                  headers=octet)
+            assert r.status == 400
+            # Garbage frame: 400.
+            r = await client.post("/internal/kv_handoff", data=b"KVGARBAGE",
+                                  headers=octet)
+            assert r.status == 400
+            assert server.migration.migrations.get(
+                ("recv", "error"), 0) == errs0 + 3
+            assert len(server.migrate_store) == 0
+            # A well-formed push parks (and is claimable exactly once).
+            r = await client.post("/internal/kv_handoff", data=blob(),
+                                  headers=octet)
+            assert r.status == 200
+            assert (await r.json())["parked"] is True
+            assert server.migrate_store.pop("park-1") is not None
+            assert server.migrate_store.pop("park-1") is None
+        loop.run_until_complete(go())
 
 
 @pytest.fixture(scope="module")

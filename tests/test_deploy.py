@@ -214,6 +214,63 @@ def test_engine_pod_graceful_drain_contract():
     assert "lifecycle" in sts_pod["containers"][0]
 
 
+def test_migration_budget_derives_termination_grace():
+    """vllmConfig.migrationBudgetSeconds: live KV migration makes the
+    SIGTERM drain transfer-bound, so the pod's SIGKILL deadline derives
+    from the (much tighter) migration budget — budget + preStop sleep (5)
+    + exit margin (10) — instead of the decode-bound 150 s default, and
+    the engine's wait-it-out fallback bound rides along as
+    --drain-grace-s. Golden pins across the three topologies."""
+    # Deployment topology: grace derived, no per-pod DNS -> no --peer-pool.
+    values = copy.deepcopy(VALUES)
+    values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"][
+        "migrationBudgetSeconds"] = 20
+    ms = render_values(values)
+    pod = ms["qwen3-engine-deployment.yaml"]["spec"]["template"]["spec"]
+    assert pod["terminationGracePeriodSeconds"] == 35
+    args = pod["containers"][0]["args"]
+    assert args[args.index("--drain-grace-s") + 1] == "20"
+    assert "--peer-pool" not in args
+    # Prefix-affinity StatefulSet: pool siblings have stable DNS, so the
+    # drain-push allowlist names them.
+    values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"][
+        "routingPolicy"] = "prefix-affinity"
+    ms = render_values(values)
+    pod = ms["qwen3-engine-statefulset.yaml"]["spec"]["template"]["spec"]
+    assert pod["terminationGracePeriodSeconds"] == 35
+    args = pod["containers"][0]["args"]
+    assert args[args.index("--peer-pool") + 1] == ",".join(
+        f"http://kgct-qwen3-engine-{i}.kgct-qwen3-engine-hl:8000"
+        for i in range(2))
+    # Disaggregated: only the DECODE pool holds streams — it gets the
+    # sibling allowlist; the prefill pool gets the budget alone.
+    ms = render_values(_disagg_values(
+        vllmConfig={"migrationBudgetSeconds": 30}))
+    for role, expect_peers in (("decode", True), ("prefill", False)):
+        pod = ms[f"m-{role}-engine-statefulset.yaml"]["spec"]["template"][
+            "spec"]
+        assert pod["terminationGracePeriodSeconds"] == 45
+        args = pod["containers"][0]["args"]
+        assert args[args.index("--drain-grace-s") + 1] == "30"
+        if expect_peers:
+            assert args[args.index("--peer-pool") + 1] == ",".join(
+                f"http://kgct-m-decode-engine-{i}"
+                f".kgct-m-decode-engine-hl:8000" for i in range(3))
+        else:
+            assert "--peer-pool" not in args
+    # Unset keeps the decode-bound default (byte-stable manifests).
+    ms = render_values(copy.deepcopy(VALUES))
+    pod = ms["qwen3-engine-deployment.yaml"]["spec"]["template"]["spec"]
+    assert pod["terminationGracePeriodSeconds"] == 150
+    assert "--drain-grace-s" not in pod["containers"][0]["args"]
+    # A budget the drain cannot use fails the render, not the pod.
+    bad = copy.deepcopy(VALUES)
+    bad["servingEngineSpec"]["modelSpec"][0]["vllmConfig"][
+        "migrationBudgetSeconds"] = 0
+    with pytest.raises(ValueError, match="migrationBudgetSeconds"):
+        render_values(bad)
+
+
 def test_router_fronts_models():
     ms = render_values(copy.deepcopy(VALUES))
     router = ms["router-deployment.yaml"]

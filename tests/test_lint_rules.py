@@ -748,3 +748,79 @@ class TestSwapOrderExportCoverage:  # KGCT010 extension
                     self.scheduler.allocator.free(seq.pages)
                     return k, v
         """, "KGCT010", relpath="engine/engine.py") == []
+
+
+class TestMigrationStateSafety:  # KGCT014
+    def test_inflight_window_in_returned_dict_fires(self):
+        """The regression the rule exists to catch: window speculation
+        (sampled-but-unfetched device tokens) serialized into the
+        cross-replica state — a peer importing it forks the stream from
+        history this engine never committed."""
+        found = lint("""
+            class Engine:
+                def export_running(self, seq):
+                    return {
+                        "output_token_ids": list(seq.output_token_ids)
+                        + list(self._inflight["toks"]),
+                        "k": self.kv_io.export_pages(seq.pages),
+                    }
+        """, "KGCT014", relpath="engine/engine.py")
+        assert len(found) == 1 and "_inflight" in found[0].message
+        assert "committed" in found[0].message
+
+    def test_window_scratch_store_into_state_fires(self):
+        found = lint("""
+            class Engine:
+                def _export_state(self, seq, k_np, v_np):
+                    state = {"k": k_np, "v": v_np}
+                    state["logprobs"] = self._window_scratch.float_b
+                    return state
+        """, "KGCT014", relpath="engine/engine.py")
+        assert len(found) == 1 and "float_b" in found[0].message
+
+    def test_zombie_set_via_update_fires(self):
+        found = lint("""
+            class Engine:
+                def export_running(self, seq):
+                    state = {}
+                    state.update(pending=self._inflight["zombies"])
+                    return state
+        """, "KGCT014", relpath="engine/engine.py")
+        assert len(found) == 1
+
+    def test_committed_only_export_with_zombie_bookkeeping_silent(self):
+        """The idiomatic export: committed host history + fetched buffers
+        into the state; the in-flight window touched ONLY for retirement
+        bookkeeping (zombie registration, deferred release) — data and
+        bookkeeping must be distinguished or the real export can never
+        pass its own rule."""
+        assert lint("""
+            class Engine:
+                def export_running(self, seq):
+                    k_np, v_np = self.kv_io.export_pages(seq.pages)
+                    state = {
+                        "prompt_token_ids": list(seq.prompt_token_ids),
+                        "output_token_ids": list(seq.output_token_ids),
+                        "output_logprobs": list(seq.output_logprobs),
+                        "k": k_np, "v": v_np,
+                    }
+                    state["mid_stream"] = True
+                    if self._inflight is not None:
+                        self._inflight["zombies"].add(seq.request_id)
+                        self._deferred_release.append(seq)
+                    return state
+        """, "KGCT014", relpath="engine/engine.py") == []
+
+    def test_non_export_functions_silent(self):
+        assert lint("""
+            class Engine:
+                def step(self):
+                    toks = self._inflight["window_toks"]
+                    return {"window": toks}
+        """, "KGCT014", relpath="engine/engine.py") == []
+
+    def test_outside_engine_scope_silent(self):
+        assert lint("""
+            def export_running(seq, inflight):
+                return {"toks": inflight["window_toks"]}
+        """, "KGCT014", relpath="serving/api_server.py") == []

@@ -655,6 +655,83 @@ class TestMergedFleetTrace:
 # ---------------------------------------------------------------------------
 
 @pytest.mark.slow
+@pytest.mark.chaos
+class TestRemapContractSoak:
+    """Live CHWBL remap-contract soak (ROADMAP 7a, software half): under
+    ``replica_down`` churn across several cycles, ONLY the downed
+    replica's ~K/N affinity keys remap (each to a deterministic ring
+    successor) and every owner returns home on recovery — the contract
+    that makes drain migration and failover land where the parked KV
+    lives. Engine-free: stub replicas, real router probes + pick seam."""
+
+    def test_churn_cycles_remap_only_owned_keys_and_recover(self):
+        N, K, CYCLES = 6, 96, 4
+
+        async def scenario():
+            runners, urls = [], []
+            for _ in range(N):
+                runner, url, _ = await _recording_replica()
+                runners.append(runner)
+                urls.append(url)
+            router = Router(urls, health_interval_s=9999,
+                            routing_policy="prefix-affinity")
+            client = await _start_router(router)
+            keys = [f"soak-session-{i}".encode() for i in range(K)]
+            try:
+                def owners():
+                    return {k: router._pick(affinity_key=k).url
+                            for k in keys}
+
+                baseline = owners()
+                by_owner: dict = {}
+                for k, u in baseline.items():
+                    by_owner.setdefault(u, []).append(k)
+                # CHWBL spreads the keys: every replica owns some, nobody
+                # owns a constant factor more than fair share (the load
+                # bound, not vnode luck, is what bounds skew — but vnode
+                # placement must not be degenerate either).
+                assert len(by_owner) == N
+                assert max(len(v) for v in by_owner.values()) <= 3 * K // N
+
+                for cycle in range(CYCLES):
+                    down = cycle % N
+                    down_url = urls[down]
+                    configure_faults(f"replica_down:value={down}")
+                    for r in router.replicas:
+                        await router._check(r, startup=True)
+                    assert not router.replicas[down].healthy
+                    churned = owners()
+                    moved = {k for k in keys
+                             if churned[k] != baseline[k]}
+                    # The remap contract: exactly the downed replica's
+                    # keys move — ~K/N, never a full reshuffle — and each
+                    # lands on ITS key's ring successor (where a drain
+                    # push / failover re-dispatch would look for it).
+                    assert moved == set(by_owner[down_url]), \
+                        f"cycle {cycle}: non-owned keys remapped"
+                    assert 0 < len(moved) <= 3 * K // N
+                    for k in moved:
+                        want = next(
+                            u for u in router.ring.walk(k)
+                            if u != down_url)
+                        assert churned[k] == want
+                    # Recovery: the owner returns, every key comes home.
+                    configure_faults(None)
+                    router.replicas[down].benched_until = 0.0
+                    for r in router.replicas:
+                        await router._check(r)
+                    assert router.replicas[down].healthy
+                    assert owners() == baseline, \
+                        f"cycle {cycle}: owners did not return on recovery"
+            finally:
+                configure_faults(None)
+                await client.close()
+                for runner in runners:
+                    await runner.cleanup()
+        asyncio.run(scenario())
+
+
+@pytest.mark.slow
 class TestRouterBenchPhase:
     def test_affinity_concentrates_locality_over_least_inflight(self):
         """The KGCT_BENCH_ROUTER A/B end-to-end: the affinity arm routes
