@@ -169,6 +169,23 @@ DRAIN_BENCH = os.environ.get("KGCT_BENCH_DRAIN", "1") != "0"
 DRAIN_SESSIONS = int(os.environ.get("KGCT_BENCH_DRAIN_SESSIONS", 6))
 DRAIN_MAX_NEW = int(os.environ.get("KGCT_BENCH_DRAIN_MAX_NEW", 48))
 
+# Multi-tenant QoS phase (engine/qos.py): a mixed chat+batch workload at
+# SATURATION — batch-tier jobs hold every scheduler seat while short
+# interactive requests arrive one at a time — A/B'd on identically-seeded
+# engines with QoS tiers on vs off. Off, each chat request queues until a
+# whole batch job finishes; on, priority make-room preemption (swap-backed)
+# and fair-share promotion admit it immediately. Headline
+# ``qos_chat_ttft_protected_ratio`` = chat p95 TTFT with QoS / without
+# (< 1 = protected). The phase also runs the per-tier ADMISSION ledger
+# under a deterministic tenant_flood chaos burst, reporting the per-tier
+# shed split (the overload must attribute to the batch tier alone).
+# KGCT_BENCH_QOS=0 skips.
+QOS_BENCH = os.environ.get("KGCT_BENCH_QOS", "1") != "0"
+QOS_BATCH_SEQS = int(os.environ.get("KGCT_BENCH_QOS_BATCH", 4))
+QOS_CHAT_REQS = int(os.environ.get("KGCT_BENCH_QOS_CHAT_REQS", 6))
+QOS_BATCH_MAX_NEW = int(os.environ.get("KGCT_BENCH_QOS_BATCH_MAX_NEW", 48))
+QOS_CHAT_MAX_NEW = int(os.environ.get("KGCT_BENCH_QOS_CHAT_MAX_NEW", 8))
+
 # The stdout contract bench.py guarantees (also the --help epilog, and what
 # tests/test_bench_contract.py pins): everything before the last line is
 # free-form noise; the LAST non-empty stdout line is the result.
@@ -867,6 +884,160 @@ def _measure_swap(model_name: str, quant, rng) -> dict:
         if sw["resume_ttft_p50_ms"] and rc["resume_ttft_p50_ms"] else None)
     out["preemptions"] = {
         "recompute_arm": rc["preemptions"], "swap_arm": sw["preemptions"]}
+    return out
+
+
+def _measure_qos(model_name: str, quant, rng) -> dict:
+    """KGCT_BENCH_QOS phase (ROADMAP item 3): multi-tenant overload
+    isolation A/B on identically-seeded engines.
+
+    Workload: QOS_BATCH_SEQS batch-tier jobs (long decodes) saturate every
+    scheduler seat, with a finished job immediately replaced so the
+    pressure never lets up; QOS_CHAT_REQS short interactive requests
+    arrive one at a time and their TTFT (add -> first emitted token) is
+    measured. QoS OFF, a chat request waits until a whole batch job
+    finishes (seat-bound FCFS); QoS ON, the scheduler's priority
+    make-room preemption swaps a batch victim out (host KV tier — the
+    cheap preemption PR 7 built) and fair-share promotion admits the chat
+    request at once. Wave 1 of each arm is a discarded compile warmup.
+
+    The admission block exercises the per-tier ledger: with the batch
+    tier's offered load inflated by the deterministic ``tenant_flood``
+    chaos site past its max_concurrent budget, batch checks shed 429s
+    while interactive checks all admit — the per-tier shed counters must
+    attribute the whole overload to the batch tier."""
+    from kubernetes_gpu_cluster_tpu.config import QoSTier
+    from kubernetes_gpu_cluster_tpu.engine.kv_cache import (
+        kv_cache_bytes_per_page)
+    from kubernetes_gpu_cluster_tpu.resilience.deadline import (
+        AdmissionController)
+    from kubernetes_gpu_cluster_tpu.resilience.faults import configure_faults
+    from kubernetes_gpu_cluster_tpu.utils.math import next_power_of_2
+
+    on_tpu = jax.default_backend() == "tpu"
+    page = PAGE if PAGE is not None else (128 if on_tpu else 16)
+    n_batch = QOS_BATCH_SEQS
+    n_chat = QOS_CHAT_REQS
+    prompt_len = max(PROMPT_LEN // page, 1) * page
+    chat_prompt = page                      # short chat turns
+    batch_new, chat_new = QOS_BATCH_MAX_NEW, QOS_CHAT_MAX_NEW
+    pages_per_seq = cdiv(prompt_len + batch_new, page)
+    # Seats are the bottleneck by construction (max_num_seqs = n_batch);
+    # the pool holds every batch job plus a chat request with slack so
+    # page pressure never confounds the seat story.
+    num_pages = (n_batch + 2) * pages_per_seq + 1
+    mcfg = get_model_config(model_name).replace(quantization=quant)
+    swap_gb = ((n_batch + 2) * pages_per_seq * kv_cache_bytes_per_page(
+        mcfg, CacheConfig(page_size=page)) + (1 << 20)) / (1 << 30)
+    tiers = (QoSTier("interactive", weight=4, priority=10,
+                     max_concurrent=max(n_chat, 4)),
+             QoSTier("batch", weight=1, priority=0, max_concurrent=2))
+    buckets = tuple(sorted({1, 2, 4, n_batch, n_batch + 1,
+                            next_power_of_2(n_batch + 1)} - {0}))
+    prefill_buckets = tuple(sorted({page, prompt_len, 2 * prompt_len}))
+    out: dict = {}
+    for label in ("qos_off", "qos_on"):
+        cfg = EngineConfig(
+            model=mcfg,
+            cache=CacheConfig(page_size=page, num_pages=num_pages,
+                              swap_space_gb=swap_gb),
+            scheduler=SchedulerConfig(
+                max_num_seqs=n_batch, max_prefill_tokens=2 * prompt_len,
+                decode_buckets=buckets, prefill_buckets=prefill_buckets,
+                decode_window=4, mixed_batch_enabled=False,
+                qos_tiers=tiers if label == "qos_on" else ()))
+        engine = LLMEngine(cfg, eos_token_id=None)
+        # qos_tier rides the params in BOTH arms: the tier-less arm
+        # ignores it (scheduler.qos is None), so the submitted workloads
+        # are literally identical.
+        batch_params = SamplingParams(max_tokens=batch_new,
+                                      temperature=0.0, qos_tier="batch")
+        chat_params = SamplingParams(max_tokens=chat_new, temperature=0.0,
+                                     qos_tier="interactive")
+
+        def run_wave(tag: str):
+            w_rng = np.random.default_rng(97)   # same workload both arms
+            nb = 0
+
+            def add_batch_job():
+                nonlocal nb
+                engine.add_request(
+                    f"{tag}-b{nb}",
+                    w_rng.integers(1, 200, prompt_len).tolist(),
+                    batch_params)
+                nb += 1
+
+            for _ in range(n_batch):
+                add_batch_job()
+            for _ in range(3):                  # batch into steady decode
+                if engine.has_unfinished_requests():
+                    engine.step()
+            ttfts: list = []
+            t_add: dict = {}
+            added = done = 0
+            while done < n_chat:
+                if added == done and added < n_chat:
+                    # One chat request in flight at a time: each sample
+                    # measures admission under full batch saturation.
+                    rid = f"{tag}-c{added}"
+                    engine.add_request(
+                        rid, w_rng.integers(1, 200, chat_prompt).tolist(),
+                        chat_params)
+                    t_add[rid] = time.monotonic()
+                    added += 1
+                outs = engine.step()
+                now = time.monotonic()
+                for o in outs:
+                    rid = o.request_id
+                    if rid in t_add and o.new_token_ids:
+                        ttfts.append(now - t_add.pop(rid))
+                    if o.finished:
+                        if rid.startswith(f"{tag}-c"):
+                            done += 1
+                        else:
+                            add_batch_job()    # keep the pressure on
+            while engine.has_unfinished_requests():
+                engine.step()
+            return ttfts
+
+        run_wave("warm")                        # compiles; discarded
+        t0 = time.perf_counter()
+        ttfts = run_wave("m")
+        wall = time.perf_counter() - t0
+        out[label] = {
+            "wall_s": round(wall, 3),
+            "chat_ttft_p50_ms": round(_median(ttfts) * 1e3, 1),
+            "chat_ttft_p95_ms": round(_percentile(ttfts, 0.95) * 1e3, 1),
+            "chat_requests": len(ttfts),
+            "preemptions": dict(engine.scheduler.num_preemptions_by_kind),
+        }
+        if label == "qos_on":
+            # Per-tier admission ledger under a deterministic flood: the
+            # batch tier's offered load is inflated past its
+            # max_concurrent budget; every batch check must shed and
+            # every interactive check must admit.
+            adm = AdmissionController(engine)
+            adm.configure_tiers(tiers, "interactive")
+            configure_faults("tenant_flood:value=8")
+            try:
+                checks = {"interactive": 0, "batch": 0}
+                for i in range(12):
+                    tier = "batch" if i % 2 else "interactive"
+                    checks[tier] += 1
+                    adm.check(None, tier=tier)
+            finally:
+                configure_faults(None)
+            out["admission"] = {
+                "checks": checks,
+                "shed_by_tier": dict(adm.shed_by_tier),
+            }
+        del engine
+        gc.collect()
+    on, off = out["qos_on"], out["qos_off"]
+    out["batch_seqs"] = n_batch
+    out["qos_chat_ttft_protected_ratio"] = (
+        round(on["chat_ttft_p95_ms"] / off["chat_ttft_p95_ms"], 3)
+        if on["chat_ttft_p95_ms"] and off["chat_ttft_p95_ms"] else None)
     return out
 
 
@@ -1712,6 +1883,12 @@ def assemble_output(results: list[dict], backend: str) -> dict:
         "swap_resume_over_recompute_ttft": (primary.get("kv_swap", {})
                                             .get("resume_ttft_ratio")),
         "preemptions": primary.get("kv_swap", {}).get("preemptions"),
+        # Multi-tenant QoS phase headline: chat p95 TTFT under batch
+        # saturation with QoS tiers on as a fraction of the tier-less
+        # engine's (< 1 = interactive traffic protected; full A/B block
+        # incl. per-tier shed attribution in configs[-1].qos).
+        "qos_chat_ttft_protected_ratio": (
+            primary.get("qos", {}).get("qos_chat_ttft_protected_ratio")),
         # Fleet-routing phase headline: warm-request TTFT through the
         # prefix-affinity router as a fraction of least-inflight's (full
         # A/B block in configs[-1].router_affinity).
@@ -1792,7 +1969,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
             "oversubscribed session workload, swap-preemption vs "
             "recompute-preemption A/B, default on; 0=skip), "
             "KGCT_BENCH_SWAP_SESSIONS, KGCT_BENCH_SWAP_OVERSUB, "
-            "KGCT_BENCH_SWAP_MAX_NEW, KGCT_BENCH_ROUTER (1=fleet-routing "
+            "KGCT_BENCH_SWAP_MAX_NEW, KGCT_BENCH_QOS (1=multi-tenant QoS "
+            "phase: chat TTFT under batch saturation, tiers on/off A/B on "
+            "identically-seeded engines + per-tier shed attribution under "
+            "tenant_flood, default on; 0=skip), KGCT_BENCH_QOS_BATCH, "
+            "KGCT_BENCH_QOS_CHAT_REQS, KGCT_BENCH_QOS_BATCH_MAX_NEW, "
+            "KGCT_BENCH_QOS_CHAT_MAX_NEW, KGCT_BENCH_ROUTER (1=fleet-routing "
             "phase: shared-prefix session workload through the real router "
             "over in-process replicas, least-inflight vs prefix-affinity "
             "A/B, default on; 0=skip), KGCT_BENCH_ROUTER_REPLICAS, "
@@ -1821,6 +2003,7 @@ _DROPPABLE_HEADLINE = ("ttft_decomposition", "baseline_bar", "mixed_batch",
                        "sampled_over_greedy", "spec_acceptance_ratio",
                        "prefix_warm_over_cold_ttft",
                        "swap_resume_over_recompute_ttft", "preemptions",
+                       "qos_chat_ttft_protected_ratio",
                        "router_affinity_warm_over_li_ttft",
                        "disagg_tpot_over_colocated",
                        "drain_migrate_over_wait_seconds",
@@ -1947,6 +2130,12 @@ def main() -> None:
         # KV-swap phase: same pattern — own small oversubscribed engines.
         primary = configs[-1]
         results[-1]["kv_swap"] = _measure_swap(
+            primary["model_name"], primary.get("quant"), rng)
+    if QOS_BENCH:
+        # Multi-tenant QoS phase: chat-vs-batch overload isolation A/B on
+        # identically-seeded engines (own small engines, primary model).
+        primary = configs[-1]
+        results[-1]["qos"] = _measure_qos(
             primary["model_name"], primary.get("quant"), rng)
     if ROUTER_BENCH:
         # Fleet-routing phase: in-process multi-replica A/B through the

@@ -19,6 +19,7 @@ from .swap_order import SwapOrderRule
 from .trace_emit import TraceEmitHygieneRule
 from .kv_boundary import KVBoundaryRule
 from .migration_state import MigrationStateSafetyRule
+from .tenant_accounting import TenantAccountingSafetyRule
 
 ALL_RULES = [
     TraceSafetyRule(),
@@ -35,6 +36,7 @@ ALL_RULES = [
     TraceEmitHygieneRule(),
     KVBoundaryRule(),
     MigrationStateSafetyRule(),
+    TenantAccountingSafetyRule(),
 ]
 
 

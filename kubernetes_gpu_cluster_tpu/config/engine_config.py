@@ -44,6 +44,39 @@ class CacheConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class QoSTier:
+    """One multi-tenant QoS priority class (engine/qos.py owns the runtime
+    accounting). Tiers are the unit of isolation: weighted fair sharing of
+    the scheduler's token budget runs across tiers, preemption victims are
+    chosen from lower-priority tiers first, and admission budgets + shed
+    accounting are kept per tier — so one flooding tenant degrades its own
+    tier while the others keep their SLO. Tier NAMES are also Prometheus
+    label values (``tier=``), so they are validated to a bounded charset at
+    parse time (engine/qos.py) — KGCT007 metric hygiene."""
+    name: str
+    # Fair-share weight: a tier's virtual-token clock advances at
+    # served_tokens / weight, so a weight-4 tier receives ~4x the service
+    # of a weight-1 tier when both have work queued.
+    weight: float = 1.0
+    # Preemption rank: HIGHER preempts lower. Victims are picked from
+    # strictly-lower-priority tiers first; a tier's own sequences are only
+    # preempted by their own tier (never by a lower one).
+    priority: int = 0
+    # Per-tier concurrent-request admission budget (serving layer): the
+    # (max_concurrent+1)-th in-flight request of this tier is shed with
+    # 429 + Retry-After while other tiers' admission is untouched.
+    # None = unbounded (the global admission machinery still applies).
+    max_concurrent: Optional[int] = None
+    # Per-tier TTFT budget for the PR-2 queue-wait shedder, applied to
+    # requests of this tier that carry no explicit x-kgct-ttft-budget-ms
+    # header. None = fall through to the operator-wide default.
+    ttft_budget_ms: Optional[float] = None
+    # Tenant keys (the request's ``session_id``/``user`` value) pinned to
+    # this tier when no explicit x-kgct-qos-tier header names one.
+    users: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class SchedulerConfig:
     """Continuous-batching scheduler limits (the hot loop the reference only
     shaped indirectly via maxModelLen / gpuMemoryUtilization, SURVEY §3.4)."""
@@ -94,6 +127,15 @@ class SchedulerConfig:
     # and drafts the continuation of the most recent match.
     spec_ngram_max: int = 3
     spec_ngram_min: int = 1
+    # Multi-tenant QoS (engine/qos.py): the configured priority classes.
+    # EMPTY (default) disables the whole QoS layer and is byte-identical
+    # to the tier-less scheduler — promotion, priority preemption, and
+    # virtual-token accounting never run. Parse operator JSON with
+    # engine/qos.parse_qos_tiers (validates names/weights/duplicates).
+    qos_tiers: tuple[QoSTier, ...] = ()
+    # Tier applied to requests that name none (no header, no user match).
+    # None = the first configured tier.
+    qos_default_tier: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)

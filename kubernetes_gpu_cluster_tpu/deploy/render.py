@@ -43,6 +43,7 @@ Mapping decisions (TPU-first, not a vLLM translation):
 from __future__ import annotations
 
 import argparse
+import json
 import os
 from typing import Any, Optional
 
@@ -79,6 +80,74 @@ def _scrape_annotations(port: int) -> dict:
         "prometheus.io/port": str(port),
         "prometheus.io/path": "/metrics",
     }
+
+
+# Multi-tenant QoS values-schema keys per tier (camelCase like every other
+# vllmConfig knob) -> the engine CLI's snake_case JSON.
+_QOS_TIER_KEYS = {"name", "weight", "priority", "maxConcurrent",
+                  "ttftBudgetMs", "users"}
+
+
+def _qos_tiers_arg(cfg: dict, where: str) -> Optional[tuple[str,
+                                                            Optional[str]]]:
+    """``qosTiers`` (list of tier objects — a LIST so duplicate names are
+    detectable) + optional ``qosDefaultTier`` -> (the ``--qos-tiers`` CLI
+    JSON, the default tier). Unknown keys, duplicate or malformed tier
+    names, non-positive weights, and a qosDefaultTier naming an
+    unconfigured tier all fail the RENDER — never the pod at start."""
+    tiers = cfg.get("qosTiers")
+    if tiers is None:
+        if cfg.get("qosDefaultTier") is not None:
+            raise ValueError(f"{where}: qosDefaultTier requires qosTiers")
+        return None
+    from ..config.qos import parse_qos_tiers, tiers_to_json
+    if not isinstance(tiers, list) or not tiers:
+        raise ValueError(f"{where}: qosTiers must be a non-empty list of "
+                         "tier objects ({name, weight, priority, "
+                         "maxConcurrent, ttftBudgetMs, users})")
+    obj: dict = {}
+    for t in tiers:
+        if not isinstance(t, dict) or not t.get("name"):
+            raise ValueError(f"{where}: every qosTiers entry needs a "
+                             "'name'")
+        name = str(t["name"])
+        unknown = set(t) - _QOS_TIER_KEYS
+        if unknown:
+            raise ValueError(
+                f"{where}: qosTiers entry {name!r} has unknown key(s) "
+                f"{', '.join(sorted(unknown))} "
+                f"(known: {', '.join(sorted(_QOS_TIER_KEYS))})")
+        if name in obj:
+            raise ValueError(f"{where}: duplicate qosTiers name {name!r}")
+        spec_out: dict = {}
+        if t.get("weight") is not None:
+            spec_out["weight"] = t["weight"]
+        if t.get("priority") is not None:
+            spec_out["priority"] = t["priority"]
+        if t.get("maxConcurrent") is not None:
+            spec_out["max_concurrent"] = t["maxConcurrent"]
+        if t.get("ttftBudgetMs") is not None:
+            spec_out["ttft_budget_ms"] = t["ttftBudgetMs"]
+        if t.get("users"):
+            if not isinstance(t["users"], (list, tuple)):
+                # A YAML scalar (`users: alice`) would list() into
+                # characters and silently pin bogus one-char tenants.
+                raise ValueError(
+                    f"{where}: qosTiers entry {name!r} users must be a "
+                    "LIST of tenant keys")
+            spec_out["users"] = list(t["users"])
+        obj[name] = spec_out
+    try:
+        parsed = parse_qos_tiers(json.dumps(obj))
+    except ValueError as e:
+        raise ValueError(f"{where}: {e}") from None
+    default = cfg.get("qosDefaultTier")
+    if default is not None and str(default) not in {t.name for t in parsed}:
+        raise ValueError(
+            f"{where}: qosDefaultTier {default!r} is not a configured "
+            f"tier (configured: {', '.join(t.name for t in parsed)})")
+    return tiers_to_json(parsed), (str(default) if default is not None
+                                   else None)
 
 
 def _engine_args(spec: dict, role: Optional[str] = None,
@@ -140,6 +209,15 @@ def _engine_args(spec: dict, role: Optional[str] = None,
         if cfg.get("numSpeculativeTokens") is not None:
             args += ["--num-speculative-tokens",
                      str(cfg["numSpeculativeTokens"])]
+    qos = _qos_tiers_arg(cfg, f"modelSpec '{spec['name']}'")
+    if qos is not None:
+        # Multi-tenant QoS: tier table -> weighted fair scheduling,
+        # priority preemption, per-tier admission budgets + shed
+        # accounting on the engine; the router gets the same table
+        # (_render_router) so both layers resolve identically.
+        args += ["--qos-tiers", qos[0]]
+        if qos[1] is not None:
+            args += ["--qos-default-tier", qos[1]]
     if cfg.get("migrationBudgetSeconds") is not None:
         # Session survivability: live KV migration on drain makes SIGTERM
         # transfer-bound, so the engine's wait-it-out fallback must fit the
@@ -532,6 +610,13 @@ def _render_router(replica_urls: list[str], router_spec: dict,
                         str(routing["affinityPrefixLen"])]
     if routing.get("balanceFactor") is not None:
         policy_args += ["--balance-factor", str(routing["balanceFactor"])]
+    if routing.get("qos"):
+        # Same validated tier table the engine pods got (one resolution
+        # order across both layers).
+        qos_json, qos_default = routing["qos"]
+        policy_args += ["--qos-tiers", qos_json]
+        if qos_default is not None:
+            policy_args += ["--qos-default-tier", qos_default]
     return {
         "router-deployment.yaml": {
             "apiVersion": "apps/v1",
@@ -794,6 +879,31 @@ def render_values(values: dict) -> dict[str, dict]:
         "affinityPrefixLen": knob("affinityPrefixLen"),
         "balanceFactor": knob("balanceFactor"),
     }
+    # Multi-tenant QoS: the router must resolve tiers with the SAME table
+    # the engines enforce, so the stack carries ONE table — conflicting
+    # qosTiers across modelSpec entries (or vs routerSpec) fail the
+    # render, like a conflicting routingPolicy would.
+    qos_by_spec: dict[str, tuple] = {}
+    for s in specs:
+        q = _qos_tiers_arg(s.get("vllmConfig") or {},
+                           f"modelSpec '{s.get('name', '?')}'")
+        if q is not None:
+            qos_by_spec[s.get("name", "?")] = q
+    router_qos = _qos_tiers_arg(router_spec, "routerSpec")
+    if len(set(qos_by_spec.values())) > 1:
+        raise ValueError(
+            "conflicting vllmConfig.qosTiers across modelSpec entries "
+            f"({', '.join(sorted(qos_by_spec))}): the stack has ONE "
+            "router resolving tiers — configure one table "
+            "(routerSpec.qosTiers)")
+    spec_qos = next(iter(qos_by_spec.values())) if qos_by_spec else None
+    if (router_qos is not None and spec_qos is not None
+            and router_qos != spec_qos):
+        raise ValueError(
+            "routerSpec.qosTiers contradicts vllmConfig.qosTiers — the "
+            "router and the engines must resolve tiers identically; set "
+            "the table in one place")
+    routing["qos"] = router_qos or spec_qos
     affinity = routing["policy"] == "prefix-affinity"
     disagg_names = [s.get("name", "?") for s in specs if _disagg(s)]
     if disagg_names and len(specs) > 1:

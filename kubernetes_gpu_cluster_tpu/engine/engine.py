@@ -180,6 +180,17 @@ class LLMEngine:
         # exports it; bench.py reads the TTFT decomposition).
         self.obs = Observability()
         self.scheduler = Scheduler(config, num_pages, obs=self.obs)
+        if self.scheduler.qos is not None:
+            # Per-tier SLO trackers + served counters (bounded label set:
+            # the configured tier names). Tiers without their own budget
+            # grade against the operator's admission default — the same
+            # bar the global tracker and per-tier admission fall back to.
+            # QoS off leaves the scrape byte-identical to the tier-less
+            # engine.
+            self.obs.configure_qos_tiers(
+                config.scheduler.qos_tiers,
+                self.scheduler.qos.default_tier,
+                fallback_budget_ms=config.resilience.default_ttft_budget_ms)
 
         params_sharding, kv_sharding = resolve_shardings(mesh, config.model)
         if mesh is not None and self.pp_size > 1:

@@ -101,6 +101,15 @@ def build_mixed_batch(sched: "Scheduler") -> Optional["ScheduledBatch"]:
     sched._try_prefix_reuse(head)
 
     # -- policy probes (no state mutation until all pass) -------------------
+    # QoS chunk-gate (mirror of the solo-chunk path's): a mid-chunk
+    # lower-priority head bows the mixed step out so the legacy admission
+    # pass can schedule the owed higher-priority waiter — decode stalls
+    # one step, exactly the legacy prefill-else-decode cost.
+    if (sched.qos is not None
+            and (head.num_prefilled > 0
+                 or head.num_tokens > sc.max_prefill_tokens)
+            and sched._qos_defer_chunk(head)):
+        return None
     # Sampled-row count D+1 must stay inside the configured decode-bucket
     # grid: falling through to next_power_of_2 would compile an unwarmed
     # out-of-grid shape mid-serving (and dodge the compile-guard's bound).
@@ -145,8 +154,9 @@ def build_mixed_batch(sched: "Scheduler") -> Optional["ScheduledBatch"]:
     # Decode first: grow every running sequence's pages for ONE decode
     # position (mixed steps advance decode by a single token — the chunk in
     # the same program runs once, so there is no multi-step window to scan).
-    # May preempt the youngest; _preempt_youngest already slots victims
-    # behind a mid-chunk head at waiting[0]. If the chunk cannot get pages
+    # May preempt the youngest (tier-aware under QoS — _preempt_victim);
+    # recompute victims already slot behind a mid-chunk head at
+    # waiting[0]. If the chunk cannot get pages
     # after this, the growth is not wasted: the fall-through decode step
     # needs exactly these pages.
     decode_seqs = sched._grow_decode_pages(window=1)

@@ -34,6 +34,12 @@ class SamplingParams:
     logit_bias: Optional[dict] = None
     # OpenAI completions logprobs=N alternatives (0..5); requires logprobs.
     top_logprobs: int = 0
+    # Multi-tenant QoS tier (priority class) this request belongs to —
+    # resolved and VALIDATED at the serving layer (header > user pin >
+    # default) against the engine's configured tiers; None when QoS is off
+    # or unresolved (the scheduler then applies its default tier). Rides
+    # to_state/from_state so a migrated stream keeps its class.
+    qos_tier: Optional[str] = None
 
     def __post_init__(self):
         if self.max_tokens < 1:
@@ -50,6 +56,8 @@ class SamplingParams:
             raise ValueError("frequency_penalty must be in [-2, 2]")
         if self.seed is not None and not isinstance(self.seed, int):
             raise ValueError("seed must be an integer")
+        if self.qos_tier is not None and not isinstance(self.qos_tier, str):
+            raise ValueError("qos_tier must be a string tier name")
         if not (0 <= self.top_logprobs <= 5):
             raise ValueError("top_logprobs must be in [0, 5]")
         if self.top_logprobs and not self.logprobs:

@@ -35,6 +35,7 @@ from ..observability import Observability
 from ..utils import cdiv, get_logger
 from ..utils.math import next_power_of_2
 from .kv_cache import CachingPageAllocator, PageAllocator
+from .qos import build_qos
 from .sequence import FinishReason, Sequence, SequenceStatus
 
 logger = get_logger("scheduler")
@@ -135,6 +136,12 @@ class Scheduler:
         # every preemption recomputes (byte-identical to the single tier).
         self.swapped: deque[Sequence] = deque()
         self.swapper = None
+        # Multi-tenant QoS (engine/qos.py): weighted fair sharing across
+        # priority classes + priority-aware preemption. None (no tiers
+        # configured) disables every QoS branch — the scheduler is then
+        # byte-identical to the tier-less engine, admission order, charge
+        # accounting, and victim selection included.
+        self.qos = build_qos(sc)
         # Sequences terminated by the scheduler itself (grown past pool
         # capacity) — the engine drains these into RequestOutputs so a client
         # waiting on the request still sees a finished event.
@@ -239,18 +246,57 @@ class Scheduler:
         if not self.running:
             return False
         victim = self.running.pop()  # admission order => last is youngest
+        return self._evict(victim)
+
+    def _evict(self, victim: Sequence, behind_head: bool = False) -> bool:
+        """Shared eviction tail: the caller already removed ``victim`` from
+        ``running``; swap it out, or fall back to recompute-requeue
+        (``behind_head`` = QoS make-room: the victim lands behind its
+        beneficiary), with the preemption accounting all paths share."""
         if self._swap_out(victim):
             return True
-        self._requeue_for_recompute(victim)
+        self._requeue_for_recompute(victim, behind_head=behind_head)
         self.num_preemptions += 1
         self.num_preemptions_by_kind["recompute"] += 1
         self.obs.on_preempt(victim, kind="recompute")
-        logger.warning("preempted %s (KV pages exhausted; free=%d)",
-                       victim.request_id, self.allocator.num_free,
+        logger.warning("preempted %s (%s; free=%d)",
+                       victim.request_id,
+                       "higher-priority admission" if behind_head
+                       else "KV pages exhausted", self.allocator.num_free,
                        extra={"request_id": victim.request_id})
         return True
 
-    def _requeue_for_recompute(self, seq: Sequence) -> None:
+    def _preempt_victim(self, from_idx: int) -> bool:
+        """Decode-growth preemption with tier awareness. QoS off keeps the
+        exact legacy choice (pop the youngest). QoS on picks, among the
+        not-yet-granted ``running[from_idx:]`` (earlier indices already got
+        this window's pages), a victim from the LOWEST-priority tier
+        strictly below the requester's — youngest within it, preserving
+        the single-tier churn properties — else the youngest of the
+        requester's OWN tier; a higher-priority sequence is never evicted
+        for a lower one (the batch job waits instead). Returns False when
+        no admissible victim exists — the caller stops growing."""
+        if self.qos is None:
+            return self._preempt_youngest()
+        cands = self.running[from_idx:]
+        if not cands:
+            return False
+        rp = self.qos.priority_of(self.running[from_idx])
+        lower = [s for s in cands if self.qos.priority_of(s) < rp]
+        if lower:
+            floor = min(self.qos.priority_of(s) for s in lower)
+            victim = [s for s in lower
+                      if self.qos.priority_of(s) == floor][-1]
+        else:
+            same = [s for s in cands if self.qos.priority_of(s) == rp]
+            if not same:
+                return False
+            victim = same[-1]
+        self.running.remove(victim)
+        return self._evict(victim)
+
+    def _requeue_for_recompute(self, seq: Sequence,
+                               behind_head: bool = False) -> None:
         """Recompute-style readmission: pages (device AND any host copy) are
         released and on readmission the prefill replays all_token_ids
         (prompt + generated so far) so the prompt/output split — and with it
@@ -258,13 +304,17 @@ class Scheduler:
         (holding pages) is only ever at waiting[0] — chunk scheduling runs
         on the head alone, so displacing it would strand its pages forever;
         requeued sequences slot in behind. Shared by recompute-preemption
-        and every swap path that degrades to it."""
+        and every swap path that degrades to it. ``behind_head``: QoS
+        make-room eviction — the victim must land BEHIND the waiting head
+        it was evicted for, or the very next admission pass would readmit
+        the victim ahead of its beneficiary."""
         self._release(seq)
         seq.status = SequenceStatus.PREEMPTED
         seq.num_prefilled = 0        # pages gone: chunk progress recomputes
         seq.prefix_checked = False   # re-lookup on readmission (cheap TTFT
                                      # recovery when the prefix is cached)
-        if self.waiting and self.waiting[0].num_prefilled > 0:
+        if self.waiting and (behind_head
+                             or self.waiting[0].num_prefilled > 0):
             self.waiting.insert(1, seq)
         else:
             self.waiting.appendleft(seq)
@@ -324,6 +374,11 @@ class Scheduler:
             seq = self.swapped[0]
             if len(self.running) >= self.max_num_seqs:
                 return
+            if self.qos is not None and self._qos_defer_restore(seq):
+                # A higher-priority tier is owed admission first: restoring
+                # this victim would grab the very pages its beneficiary
+                # needs and thrash the pair through the host tier.
+                return
             need = cdiv(seq.num_tokens - 1, self.page_size)
             # Gate on pages for the committed KV PLUS the next decode
             # window: a bare-committed restore would be the very next
@@ -373,14 +428,187 @@ class Scheduler:
             self.swapper.notify_restored(seq)
             self.obs.on_scheduled(seq, 1)    # emits the "resume" event
 
+    # -- QoS: weighted fair sharing + priority preemption --------------------
+    # Every method below is reachable only with ``self.qos`` set (tiers
+    # configured); the tier-less scheduler never enters them. Virtual-token
+    # clocks are mutated ONLY through qos.charge/sync_active from this
+    # seam (KGCT015 tenant-accounting-safety).
+
+    def _qos_fresh_waiting(self):
+        """(seq, tier name) for waiting sequences that can be freely
+        reordered: no chunk progress and no pages held — a mid-chunk head
+        must stay at waiting[0] (chunk scheduling runs on the head alone)."""
+        for seq in self.waiting:
+            if seq.num_prefilled == 0 and not seq.pages:
+                yield seq, self.qos.resolve(seq.params.qos_tier)
+
+    def _qos_pass(self) -> None:
+        """Once per schedule() — on EVERY call, waiting-empty included:
+        sync the tier activity set first (a tier's departure during a
+        pure-decode stretch must be observed, or its later return would
+        skip the idle catch-up and spend arbitrarily large banked
+        credit), then promote the owed tier's first fresh waiting
+        sequence to the queue head, then make room for it by priority
+        preemption when seats/pages block its admission."""
+        qos = self.qos
+        qos.sync_active(
+            qos.resolve(s.params.qos_tier)
+            for bucket in (self.waiting, self.running, self.swapped)
+            for s in bucket)
+        if not self.waiting:
+            return
+        self._qos_promote()
+        self._qos_make_room()
+
+    def _qos_promote(self) -> None:
+        """Weighted-fair admission order: move the first fresh waiting
+        sequence of the tier with the smallest virtual clock to the queue
+        head. FCFS is preserved WITHIN a tier (always the tier's first
+        sequence); a mid-chunk or page-holding head is never displaced."""
+        if len(self.waiting) < 2:
+            return
+        head = self.waiting[0]
+        if head.num_prefilled > 0 or head.pages:
+            return
+        fresh = list(self._qos_fresh_waiting())
+        want = self.qos.pick_tier(name for _, name in fresh)
+        if want is None or self.qos.resolve(head.params.qos_tier) == want:
+            return
+        for seq, name in fresh:
+            if name == want:
+                self.waiting.remove(seq)
+                self.waiting.appendleft(seq)
+                return
+
+    def _qos_make_room(self) -> None:
+        """Priority admission preemption: when the (promoted) fresh head is
+        blocked by seats or pages, evict strictly-LOWER-priority running
+        sequences (lowest tier first, youngest within it) until it fits or
+        no admissible victim remains — by swap when the host tier is on
+        (the cheap path the two-tier KV cache exists for), by recompute
+        otherwise, with the victim requeued BEHIND its beneficiary. Same-
+        or higher-priority running work is never touched: within a tier
+        the no-preempt-for-admission invariant (and its churn rationale)
+        still holds."""
+        if not self.waiting:
+            return
+        head = self.waiting[0]
+        if head.num_prefilled > 0 or head.pages:
+            return
+        hp = self.qos.priority_of(head)
+        need = min(cdiv(head.num_tokens, self.page_size),
+                   cdiv(self.max_prefill_tokens, self.page_size))
+        while (len(self.running) >= self.max_num_seqs
+               or not self.allocator.can_allocate(need)):
+            victim = None
+            floor = hp
+            for s in self.running:
+                p = self.qos.priority_of(s)
+                if p < floor or (victim is not None
+                                 and p == floor):
+                    # < floor: strictly lower tier found; == floor after a
+                    # first hit: later admission = younger within the tier.
+                    victim = s
+                    floor = p
+            if victim is None:
+                return
+            self.running.remove(victim)
+            self._evict(victim, behind_head=True)
+
+    def _qos_defer_chunk(self, head: Sequence) -> bool:
+        """Chunk-gate: pause the mid-chunk head's next chunk when a fresh
+        PACKABLE waiting sequence of a strictly-HIGHER-priority tier is
+        owed service (the head's tier clock has run ahead of the waiter's)
+        — the admission pass below then schedules the waiter instead,
+        bounding how far a batch-tier long prompt can push an interactive
+        request's first schedule (its deficit bound: at most the chunk in
+        flight when the waiter arrived). Self-releasing: serving the
+        waiter advances its clock until the comparison flips, so the
+        paused chunk never starves. Only waiters the packed admission loop
+        CAN admit (num_tokens <= max_prefill_tokens) qualify: a chunkable
+        waiter runs solo from waiting[0] only, so deferring the head for
+        it would schedule neither sequence and freeze both clocks — a
+        permanent stall, not a fairness win."""
+        head_tier = self.qos.resolve(head.params.qos_tier)
+        head_prio = self.qos.priority_of(head)
+        for seq, name in self._qos_fresh_waiting():
+            if (seq.num_tokens <= self.max_prefill_tokens
+                    and self.qos.tiers[name].priority > head_prio
+                    and self.qos.owes(head_tier, name)):
+                return True
+        return False
+
+    def _qos_defer_restore(self, seq: Sequence) -> bool:
+        """Restore-gate (mirror of the chunk gate for the swapped queue):
+        hold a swapped victim's readmission while a fresh waiting sequence
+        of a strictly-higher-priority tier is owed service — restoring
+        first would hand the victim the pages its beneficiary was evicted
+        to free."""
+        victim_tier = self.qos.resolve(seq.params.qos_tier)
+        victim_prio = self.qos.priority_of(seq)
+        for waiter, name in self._qos_fresh_waiting():
+            # Same packability restriction as the chunk gate: a chunkable
+            # waiter is served from waiting[0] via the chunk path, which a
+            # deferred restore cannot unblock — only waiters the packed
+            # loop can admit justify holding the restore.
+            if (waiter.num_tokens <= self.max_prefill_tokens
+                    and self.qos.tiers[name].priority > victim_prio
+                    and self.qos.owes(victim_tier, name)):
+                return True
+        return False
+
+    def _qos_charge_batch(self, batch: ScheduledBatch) -> None:
+        """THE service-accounting site: every scheduled batch charges its
+        granted tokens to its sequences' tier clocks here, once, at the
+        single exit of schedule(). Prefill charges prompt/chunk tokens,
+        decode charges the window each row may advance, mixed charges one
+        token per decode row plus the chunk, spec charges the verify width
+        per row — relative shares are what fairness runs on."""
+        qos = self.qos
+        sc = self.config.scheduler
+        if batch.kind == "prefill":
+            if batch.hist_len is not None:
+                seq = batch.seqs[0]
+                qos.charge(qos.resolve(seq.params.qos_tier),
+                           seq.num_prefilled - batch.hist_len)
+            else:
+                for seq in batch.seqs:
+                    qos.charge(qos.resolve(seq.params.qos_tier),
+                               seq.num_tokens)
+        elif batch.kind == "decode":
+            for seq in batch.seqs:
+                qos.charge(qos.resolve(seq.params.qos_tier),
+                           sc.decode_window)
+        elif batch.kind == "mixed":
+            for seq in batch.seqs[:-1]:
+                qos.charge(qos.resolve(seq.params.qos_tier), 1)
+            chunk_seq = batch.seqs[-1]
+            qos.charge(qos.resolve(chunk_seq.params.qos_tier),
+                       max(batch.prefill_token_count, 1))
+        elif batch.kind == "spec":
+            for seq in batch.seqs:
+                qos.charge(qos.resolve(seq.params.qos_tier),
+                           sc.num_speculative_tokens + 1)
+
     # -- scheduling ---------------------------------------------------------
 
     def schedule(self) -> Optional[ScheduledBatch]:
+        batch = self._schedule_inner()
+        if self.qos is not None and batch is not None:
+            self._qos_charge_batch(batch)
+        return batch
+
+    def _schedule_inner(self) -> Optional[ScheduledBatch]:
         # Swap-readmission first: restored sequences rejoin ``running`` and
         # ride whatever batch this very call builds — resumption is a
         # memcpy plus a decode step, never a prefill.
         if self.swapped:
             self._restore_swapped()
+        # Multi-tenant QoS: activity sync runs every call (idle tracking);
+        # fair-share promotion + priority make-room run before any
+        # admission path looks at the queue.
+        if self.qos is not None:
+            self._qos_pass()
         # Stall-free mixing: when running decodes and waiting prefill work
         # coexist, one device step carries both (engine/mixed_batch.py).
         # Every other state — and every case mixing cannot serve (no budget
@@ -426,9 +654,16 @@ class Scheduler:
             head = self.waiting[0]
             self._try_prefix_reuse(head)
             if head.num_prefilled > 0 or head.num_tokens > self.max_prefill_tokens:
-                batch = self._schedule_chunk(head)
-                if batch is not None:
-                    return batch
+                # QoS chunk-gate: a mid-chunk lower-priority head yields
+                # this step's prefill budget to an owed higher-priority
+                # waiter (admitted by the lookahead loop below); the head
+                # keeps its pages and resumes chunking once the waiter's
+                # clock catches up.
+                if not (self.qos is not None
+                        and self._qos_defer_chunk(head)):
+                    batch = self._schedule_chunk(head)
+                    if batch is not None:
+                        return batch
 
         admitted: list[Sequence] = []
         total_tokens = 0
@@ -438,6 +673,17 @@ class Scheduler:
             seq = self.waiting[i]
             if len(self.running) + len(admitted) >= self.max_num_seqs:
                 break
+            if seq.num_prefilled > 0 or seq.pages:
+                # Mid-chunk / prefix-held sequences advance ONLY through
+                # the chunk path on the head: admitting one here would
+                # assign fresh pages over its held (possibly cache-shared)
+                # list, leaking the refcounted prefix pages. Unreachable
+                # with QoS off (a blocked chunk implies this loop's
+                # stricter seat/page checks also fail); the QoS chunk-defer
+                # gate makes it reachable with pages plentiful.
+                skipped += 1
+                i += 1
+                continue
             if seq.num_tokens > self.max_prefill_tokens:
                 # Chunkable sequence mid-queue: solo-only, skip for this batch.
                 skipped += 1
@@ -662,9 +908,13 @@ class Scheduler:
                 if self.allocator.can_allocate(grow):
                     seq.pages.extend(self.allocator.allocate(grow))
                 else:
-                    if not self._preempt_youngest():
+                    # Victim selection: legacy youngest-last when QoS is
+                    # off; tier-aware (lowest-priority-first, never a
+                    # higher tier for a lower requester) when on — always
+                    # among running[idx:], the not-yet-granted tail.
+                    if not self._preempt_victim(idx):
                         break
-                    continue  # retry same index (list shrank from the back)
+                    continue  # retry same index (list shrank behind idx)
             scheduled.append(seq)
             idx += 1
         return scheduled

@@ -152,6 +152,14 @@ class Observability:
         # signals. The API server points ttft_budget_ms at the admission
         # controller's budget so both layers grade against one bar.
         self.slo = SLOTracker()
+        # Multi-tenant QoS: per-tier SLO trackers + served counters, keyed
+        # by the CONFIGURED tier names only (bounded label cardinality,
+        # KGCT007 — never raw user ids). Empty when QoS is off: no labeled
+        # series render and the scrape is byte-identical to the tier-less
+        # server. configure_qos_tiers wires them from engine config.
+        self.slo_by_tier: dict[str, SLOTracker] = {}
+        self.finished_by_tier: dict[str, int] = {}
+        self._qos_default_tier: str = ""
         self.phases = StepPhaseStats()
         self.ttft = Histogram(
             "kgct_ttft_seconds", "time to first token", labels=("outcome",))
@@ -199,6 +207,33 @@ class Observability:
             "kgct_kv_swap_seconds", "host<->device KV page transfer latency",
             labels=("dir",))
 
+    # -- multi-tenant QoS ----------------------------------------------------
+
+    def configure_qos_tiers(self, tiers, default_tier: str,
+                            fallback_budget_ms=None) -> None:
+        """Install the per-tier SLO trackers: one per CONFIGURED tier
+        (bounded cardinality), graded against the tier's own TTFT budget
+        when it has one, else ``fallback_budget_ms`` — the operator's
+        admission default, so a tier child and the global tracker grade
+        the same request against the same bar (None keeps the north-star
+        default, matching the global tracker's own fallback). Called once
+        at engine construction when QoS is on."""
+        self.slo_by_tier = {
+            t.name: SLOTracker(ttft_budget_ms=(
+                t.ttft_budget_ms if t.ttft_budget_ms is not None
+                else fallback_budget_ms))
+            for t in tiers}
+        self.finished_by_tier = {t.name: 0 for t in tiers}
+        self._qos_default_tier = default_tier
+
+    def _tier_slo(self, seq) -> "Optional[SLOTracker]":
+        if not self.slo_by_tier:
+            return None
+        name = getattr(getattr(seq, "params", None), "qos_tier", None)
+        if name not in self.slo_by_tier:
+            name = self._qos_default_tier
+        return self.slo_by_tier.get(name)
+
     # -- request lifecycle hooks (engine + scheduler) ------------------------
 
     def on_arrival(self, seq) -> None:
@@ -239,6 +274,9 @@ class Observability:
         ttft = seq.first_token_time - seq.arrival_time
         self.ttft.observe(ttft, (_outcome(seq, None),))
         self.slo.on_first_token(ttft)
+        tier_slo = self._tier_slo(seq)
+        if tier_slo is not None:
+            tier_slo.on_first_token(ttft)
         queue = ((seq.scheduled_time - seq.arrival_time)
                  if seq.scheduled_time is not None else 0.0)
         prefill = max(ttft - queue - fetch_s, 0.0)
@@ -261,6 +299,9 @@ class Observability:
         seq.handoff_ttft_s = ttft_s
         self.ttft.observe(ttft_s, (_outcome(seq, None),))
         self.slo.on_first_token(ttft_s)
+        tier_slo = self._tier_slo(seq)
+        if tier_slo is not None:
+            tier_slo.on_first_token(ttft_s)
         self.tracer.emit("first_token", seq.request_id,
                          ttft_ms=round(ttft_s * 1e3, 2), handoff=True)
 
@@ -283,6 +324,15 @@ class Observability:
                     if getattr(seq, "handoff_ttft_s", None) is not None
                     else seq.first_token_time - seq.arrival_time)
             self.slo.on_finish(ttft, n)
+            tier_slo = self._tier_slo(seq)
+            if tier_slo is not None:
+                tier_slo.on_finish(ttft, n)
+        if self.finished_by_tier and outcome != "aborted":
+            name = getattr(getattr(seq, "params", None), "qos_tier", None)
+            if name not in self.finished_by_tier:
+                name = self._qos_default_tier
+            if name in self.finished_by_tier:
+                self.finished_by_tier[name] += 1
         if seq.first_token_time is not None and n >= 2:
             self.tpot.observe(
                 (seq.finish_time - seq.first_token_time) / (n - 1))
@@ -395,16 +445,38 @@ class Observability:
         # Rolling SLO layer (autoscaler signals, ROADMAP 4(b)): attainment
         # of the admission-control TTFT budget over recent requests, the
         # budget itself, and budget-meeting goodput. 1.0 / 0.0 when fresh.
+        # Multi-tenant QoS: the attainment/goodput families gain a
+        # bounded-cardinality ``tier`` label (values = configured tier
+        # names only), rendered inside each family's TYPE block. Absent
+        # entirely when QoS is off; zeros/1.0-safe on a fresh scrape (an
+        # empty window has missed nothing).
+        tier_names = sorted(self.slo_by_tier)
         lines += [
             "# TYPE kgct_slo_ttft_budget_ms gauge",
             f"kgct_slo_ttft_budget_ms {fmt(self.slo.budget_ms)}",
             "# TYPE kgct_slo_ttft_attainment_ratio gauge",
             "kgct_slo_ttft_attainment_ratio "
             f"{fmt(round(self.slo.attainment(), 6))}",
+        ]
+        lines += [
+            f'kgct_slo_ttft_attainment_ratio{{tier="{n}"}} '
+            f"{fmt(round(self.slo_by_tier[n].attainment(), 6))}"
+            for n in tier_names]
+        lines += [
             "# TYPE kgct_slo_goodput_tokens_per_sec gauge",
             "kgct_slo_goodput_tokens_per_sec "
             f"{fmt(round(self.slo.goodput_tokens_per_sec(), 3))}",
         ]
+        lines += [
+            f'kgct_slo_goodput_tokens_per_sec{{tier="{n}"}} '
+            f"{fmt(round(self.slo_by_tier[n].goodput_tokens_per_sec(), 3))}"
+            for n in tier_names]
+        if self.finished_by_tier:
+            lines.append("# TYPE kgct_qos_requests_finished_total counter")
+            for name in sorted(self.finished_by_tier):
+                lines.append(
+                    f'kgct_qos_requests_finished_total{{tier="{name}"}} '
+                    f"{self.finished_by_tier[name]}")
         lines.extend(render_gauge("kgct_sampled_decode_ratio",
                                   self.sampled_decode_ratio()))
         lines.extend(render_gauge("kgct_mixed_step_ratio",

@@ -38,9 +38,25 @@ class ResilienceHub:
         self.drain = drain
 
     def render_prometheus(self) -> list[str]:
-        return [
+        lines = [
             "# TYPE kgct_requests_shed_total counter",
             f"kgct_requests_shed_total {self.admission.shed_total}",
+        ]
+        # Multi-tenant QoS: per-tier shed attribution inside the same
+        # family — label values are the CONFIGURED tier names only
+        # (bounded cardinality, KGCT007), zeros from the first scrape,
+        # absent entirely when QoS is off (byte-identical exposition).
+        lines += [
+            f'kgct_requests_shed_total{{tier="{n}"}} '
+            f"{self.admission.shed_by_tier[n]}"
+            for n in sorted(self.admission.shed_by_tier)]
+        if self.admission.tier_inflight:
+            lines.append("# TYPE kgct_qos_tier_inflight gauge")
+            lines += [
+                f'kgct_qos_tier_inflight{{tier="{n}"}} '
+                f"{self.admission.tier_inflight[n]}"
+                for n in sorted(self.admission.tier_inflight)]
+        lines += [
             "# TYPE kgct_watchdog_trips_total counter",
             f"kgct_watchdog_trips_total {self.watchdog.trips}",
             # 0 = serving, 1 = draining, 2 = drained (gauge, not counter:
@@ -48,3 +64,4 @@ class ResilienceHub:
             "# TYPE kgct_drain_state gauge",
             f"kgct_drain_state {self.drain.gauge_value}",
         ]
+        return lines

@@ -41,6 +41,12 @@ point (grep for ``inject(`` / ``fault_value(``):
 - ``migrate_fail``     draining replica: the live-migration export/push
                        raises before the sequence detaches -> per-sequence
                        fallback to the wait-it-out drain path
+- ``tenant_flood``     admission controller (multi-tenant QoS): the
+                       LOWEST-priority tier's offered load is inflated by
+                       ``value`` phantom in-flight requests, so that tier
+                       deterministically blows its max_concurrent budget
+                       and absorbs 429s while higher tiers' admission is
+                       untouched (the overload-isolation chaos drill)
 
 Params (all optional): ``p`` fire probability in [0, 1] (default 1; drawn
 from a PRIVATE ``random.Random(seed)`` per rule, so sequences are

@@ -64,8 +64,9 @@ from ..resilience.drain import drain_and_notify
 from ..resilience.faults import inject as _inject_fault
 from ..utils import get_logger
 from .async_engine import AsyncLLMEngine
+from ..engine.qos import resolve_tier_name, tenant_key_of
 from .errors import (MIGRATE_URL_HEADER, PREFILL_URL_HEADER,
-                     REQUEST_ID_HEADER, RESUME_MODE_HEADER,
+                     QOS_TIER_HEADER, REQUEST_ID_HEADER, RESUME_MODE_HEADER,
                      StreamMigratedError, valid_request_id)
 from .errors import overloaded_error as _overloaded
 from .handoff import (HANDOFF_TIMEOUT_S, MIGRATE_PUSH_TIMEOUT_S,
@@ -297,6 +298,19 @@ class APIServer:
         self.admission = AdmissionController(
             engine.engine, default_budget_ms=res.default_ttft_budget_ms,
             quantile=res.admission_quantile)
+        # Multi-tenant QoS: the tier table lives in the ENGINE config (one
+        # source for scheduler fairness AND serving admission); the
+        # admission controller gets the per-tier budgets, /health and
+        # /metrics the per-tier inflight/shed ledgers. Empty = QoS off,
+        # byte-identical serving.
+        sc = engine.engine.config.scheduler
+        self.qos_tiers = sc.qos_tiers
+        self.qos_default_tier = (
+            engine.engine.scheduler.qos.default_tier
+            if engine.engine.scheduler.qos is not None else None)
+        if self.qos_tiers:
+            self.admission.configure_tiers(self.qos_tiers,
+                                           self.qos_default_tier)
         self.hub = ResilienceHub(self.admission, self.watchdog,
                                  self.drain_state)
         # The worker thread arms/disarms the watchdog around each step().
@@ -513,11 +527,34 @@ class APIServer:
         # post_exception touches only the output queue).
         self.engine.post_exception(rid, StreamMigratedError(url))
 
-    def _admission_gate(self, request: web.Request) -> Optional[web.Response]:
+    def _resolve_tier(self, request: web.Request, body: Optional[dict]
+                      ) -> tuple[Optional[str], Optional[web.Response]]:
+        """(resolved tier name, error response): the replica-side half of
+        the one tier-resolution order (engine/qos.resolve_tier_name) —
+        explicit ``x-kgct-qos-tier`` header (must name a configured tier,
+        else a loud 400) > the ``session_id``/``user`` tenant key against
+        the tiers' user pins > the default tier. (None, None) when QoS is
+        off: the header is ignored and nothing resolves."""
+        if not self.qos_tiers:
+            return None, None
+        name, err = resolve_tier_name(
+            self.qos_tiers, self.qos_default_tier,
+            header=request.headers.get(QOS_TIER_HEADER),
+            tenant_key=tenant_key_of(body))
+        if err is not None:
+            return None, _error(400, err)
+        return name, None
+
+    def _admission_gate(self, request: web.Request,
+                        tier: Optional[str] = None
+                        ) -> Optional[web.Response]:
         """None = admit. A Response = reject BEFORE the request touches the
         engine: 503 while draining (k8s is taking the pod out of rotation),
         429 + Retry-After when the estimated queue wait already blows the
-        request's TTFT budget (vLLM-style shed-don't-queue)."""
+        request's TTFT budget (vLLM-style shed-don't-queue) OR the
+        request's QoS tier is at its per-tier concurrency budget — the
+        flooding tenant's tier absorbs the 429s while other tiers'
+        admission is untouched (per-tier shed accounting)."""
         if self.drain_state.is_draining:
             return _overloaded(503, "server is draining for shutdown; "
                                "retry against another replica", 5)
@@ -536,12 +573,14 @@ class APIServer:
             if not math.isfinite(budget_ms) or budget_ms <= 0:
                 return _error(400, f"{TTFT_BUDGET_HEADER} must be a finite "
                                    "number > 0")
-        retry_after = self.admission.check(budget_ms)
+        retry_after = self.admission.check(budget_ms, tier=tier)
         if retry_after is not None:
             est_ms = round(self.admission.last_estimate_s * 1e3, 1)
             rid = request.get("kgct_request_id")
-            logger.info("request shed: estimated queue wait %.1f ms over "
-                        "TTFT budget (retry-after %ss)", est_ms, retry_after,
+            logger.info("request shed%s: estimated queue wait %.1f ms over "
+                        "budget (retry-after %ss)",
+                        f" (tier={tier})" if tier else "",
+                        est_ms, retry_after,
                         extra={"request_id": rid} if rid else None)
             return _overloaded(
                 429, f"request shed: estimated queue wait {est_ms} ms "
@@ -556,6 +595,11 @@ class APIServer:
         body = {"status": "ok", "model": self.model_name, "role": self.role,
                 "waiting": len(sched.waiting), "running": len(sched.running),
                 "swapped": len(sched.swapped)}
+        if self.qos_tiers:
+            # Per-tier in-flight requests (the admission ledger) — the
+            # operator's one-look answer to "which tenant class is loading
+            # this replica"; absent when QoS is off.
+            body["qos_tiers"] = dict(self.admission.tier_inflight)
         if self.drain_state.is_draining:
             body["status"] = self.drain_state.state
             return web.json_response(body, status=503)
@@ -677,13 +721,20 @@ class APIServer:
         if self.role == "decode" or not self._handoff_ok:
             return _error(404, f"kv handoff is not served by this replica "
                                f"(role={self.role})")
-        gate = self._admission_gate(request)
-        if gate is not None:
-            return gate
         try:
             body = await request.json()
         except Exception:
             return _error(400, "invalid JSON body")
+        # Resolve the tier BEFORE the gate (the decode replica forwards
+        # its resolution in QOS_TIER_HEADER; the body carries the tenant
+        # key): the pull must be gated against — and any shed attributed
+        # to — the REQUESTING tier's budgets, never the default tier's.
+        tier, terr = self._resolve_tier(request, body)
+        if terr is not None:
+            return terr
+        gate = self._admission_gate(request, tier=tier)
+        if gate is not None:
+            return gate
         ids = body.get("prompt_token_ids")
         if (not isinstance(ids, list) or not ids
                 or not all(isinstance(t, int) and not isinstance(t, bool)
@@ -698,6 +749,11 @@ class APIServer:
                                       n_logprobs=n_lp)
         except (TypeError, ValueError) as e:
             return _error(400, str(e))
+        if tier is not None:
+            # Resolved above (forwarded header > tenant key > default):
+            # the remote prefill competes in THIS replica's fair-share
+            # scheduler under the requesting class.
+            params = dataclasses.replace(params, qos_tier=tier)
         params = dataclasses.replace(params, max_tokens=1)
         rid = request.get("kgct_request_id") or self.engine.next_request_id(
             "handoff")
@@ -868,6 +924,15 @@ class APIServer:
                                       n_logprobs=n_lp)
         except (TypeError, ValueError) as e:
             return _error(400, str(e))
+        # A resumed stream keeps its QoS class: re-resolve from the
+        # replayed body's tenant key (the failover dispatch carries no
+        # client headers), so a migrated interactive stream is not
+        # silently re-classed to the default tier here.
+        tier, terr = self._resolve_tier(request, body)
+        if terr is not None:
+            return terr
+        if tier is not None:
+            params = dataclasses.replace(params, qos_tier=tier)
         obs = self.engine.engine.obs
         parked = self.migrate_store.pop(rid)
         if parked is not None:
@@ -991,7 +1056,8 @@ class APIServer:
         return resp
 
     async def _pull_handoff(self, prefill_url: str, rid: str, body: dict,
-                            ids: list[int]) -> Optional[dict]:
+                            ids: list[int],
+                            tier: Optional[str] = None) -> Optional[dict]:
         """Decode-replica half: pull the prefilled KV from ``prefill_url``
         (bounded read + wall bound, serving/handoff.py) and decode the
         blob. Returns None on ANY failure — including the deterministic
@@ -1011,7 +1077,8 @@ class APIServer:
                 self._http = aiohttp.ClientSession()
             data = await fetch_handoff(
                 self._http, prefill_url, handoff_request_body(ids, body),
-                rid, self._handoff_max_bytes, timeout_s=HANDOFF_TIMEOUT_S)
+                rid, self._handoff_max_bytes, timeout_s=HANDOFF_TIMEOUT_S,
+                qos_tier=tier)
             state = decode_handoff(data)
         except Exception as e:
             dt = time.perf_counter() - t0
@@ -1053,9 +1120,27 @@ class APIServer:
 
     async def _run(self, request: web.Request, body: dict, ids: list[int],
                    kind: str) -> web.StreamResponse:
-        gate = self._admission_gate(request)
+        # QoS tier resolution precedes the gate (the gate charges the shed
+        # to the tier); the inflight pair brackets the WHOLE request
+        # lifetime, streaming included, so max_concurrent bounds live
+        # concurrency, not submission rate.
+        tier, terr = self._resolve_tier(request, body)
+        if terr is not None:
+            return terr
+        gate = self._admission_gate(request, tier=tier)
         if gate is not None:
             return gate
+        if tier is None:
+            return await self._run_admitted(request, body, ids, kind, tier)
+        self.admission.on_admit(tier)
+        try:
+            return await self._run_admitted(request, body, ids, kind, tier)
+        finally:
+            self.admission.on_release(tier)
+
+    async def _run_admitted(self, request: web.Request, body: dict,
+                            ids: list[int], kind: str,
+                            tier: Optional[str]) -> web.StreamResponse:
         # Session/user passthrough (the router's affinity keys): accepted on
         # every completion body so clients can pin a session to one replica
         # via the prefix-affinity router. Validated here — a non-scalar
@@ -1089,6 +1174,11 @@ class APIServer:
                                       n_logprobs=n_lp)
         except (TypeError, ValueError) as e:
             return _error(400, str(e))
+        if tier is not None:
+            # Thread the RESOLVED class into the engine: the scheduler's
+            # fair-share/preemption decisions key off params.qos_tier, and
+            # to_state carries it across migration/handoff hops.
+            params = dataclasses.replace(params, qos_tier=tier)
         detok = IncrementalDetokenizer(self.tokenizer, stop=_stops(body))
         # The middleware-adopted correlation id (router-minted or inbound)
         # IS the engine request id — the lifecycle tracer's events then
@@ -1147,7 +1237,7 @@ class APIServer:
             else:
                 t0 = time.monotonic()
                 handoff = await self._pull_handoff(prefill_url, rid, body,
-                                                   ids)
+                                                   ids, tier=tier)
                 if handoff is not None:
                     # import_request turns this into the decode-side TTFT
                     # sample (remote prefill + transfer + import).
@@ -1640,6 +1730,22 @@ def main(argv: Optional[list[str]] = None) -> None:
                    "FALLBACK bound; the deploy renderer derives it (and "
                    "terminationGracePeriodSeconds) from "
                    "migrationBudgetSeconds")
+    p.add_argument("--qos-tiers", default=None,
+                   help="multi-tenant QoS priority classes as JSON "
+                   '({"interactive": {"weight": 4, "priority": 10, '
+                   '"max_concurrent": 64, "ttft_budget_ms": 1000, '
+                   '"users": ["alice"]}, "batch": {...}}), or the literal '
+                   "'default' for the canonical interactive/batch pair. "
+                   "Tiers drive weighted fair scheduling (virtual-token "
+                   "deficit across tiers), priority-aware preemption "
+                   "(batch-tier victims first), per-tier admission budgets "
+                   "+ shed accounting, and the x-kgct-qos-tier header / "
+                   "user-pin resolution. Unset = QoS off, byte-identical "
+                   "serving")
+    p.add_argument("--qos-default-tier", default=None,
+                   help="tier applied to requests that name none (no "
+                   "header, no user pin); default: the first configured "
+                   "tier")
     p.add_argument("--enforce-eager", action="store_true",
                    help="disable jit compile caching (debug; always slower)")
     p.add_argument("--trust-remote-code", action="store_true",
@@ -1683,6 +1789,17 @@ def main(argv: Optional[list[str]] = None) -> None:
         logger.info("GPU-parity flags accepted and ignored "
                     "(--trust-remote-code / --disable-custom-all-reduce)")
     from ..config import SchedulerConfig
+    from ..engine.qos import parse_qos_tiers
+    try:
+        qos_tiers = parse_qos_tiers(args.qos_tiers)
+    except ValueError as e:
+        p.error(str(e))
+    if args.qos_default_tier is not None:
+        if not qos_tiers:
+            p.error("--qos-default-tier requires --qos-tiers")
+        if args.qos_default_tier not in {t.name for t in qos_tiers}:
+            p.error(f"--qos-default-tier {args.qos_default_tier!r} is not "
+                    "a configured tier")
     config = EngineConfig(
         model=model_cfg,
         cache=CacheConfig(hbm_utilization=args.hbm_utilization,
@@ -1693,7 +1810,9 @@ def main(argv: Optional[list[str]] = None) -> None:
             mixed_batch_enabled=not args.disable_mixed_batch,
             decode_priority_token_budget=args.decode_priority_token_budget,
             spec_decode_enabled=args.enable_spec_decode,
-            num_speculative_tokens=args.num_speculative_tokens),
+            num_speculative_tokens=args.num_speculative_tokens,
+            qos_tiers=qos_tiers,
+            qos_default_tier=args.qos_default_tier),
         parallel=ParallelConfig(tp=args.tensor_parallel_size,
                                 pp=args.pipeline_parallel_size,
                                 sp=args.sequence_parallel_size,

@@ -40,6 +40,17 @@ PREFILL_URL_HEADER = "x-kgct-prefill-url"
 # proxy; ``--peer-pool`` is the direct-to-pod allowlist).
 MIGRATE_URL_HEADER = "x-kgct-migrate-url"
 
+# Multi-tenant QoS: the request's priority class. Resolution order (one
+# definition, engine/qos.resolve_tier_name, shared by router and replica):
+# a valid inbound header naming a CONFIGURED tier wins; else the
+# ``session_id``/``user`` tenant key is looked up against the tiers' user
+# pins; else the default tier. The router propagates the tier it resolved
+# upstream in this header so both layers attribute the request
+# identically; a header naming an unconfigured tier is a 400 at the
+# replica (loud, not silently re-classed). Ignored when no tiers are
+# configured (QoS off is byte-identical to today).
+QOS_TIER_HEADER = "x-kgct-qos-tier"
+
 # Echoed by ``POST /internal/resume``: how the resumed stream was
 # reconstructed — "import" (parked migrated KV scattered in, decode
 # resumes directly) or "recompute" (token-replay re-prefill). The router

@@ -52,6 +52,12 @@ HANDOFF_TIMEOUT_S = 120.0
 FORWARDED_SAMPLING_FIELDS = (
     "temperature", "top_p", "top_k", "seed", "presence_penalty",
     "frequency_penalty", "logit_bias", "stop_token_ids", "logprobs",
+    # QoS tenant keys: the prefill replica resolves the request's tier
+    # from them (user-pin > default — the pull carries no client
+    # headers), so a batch prompt's remote prefill competes in the
+    # prefill pool's own fair-share scheduler as batch work, not as
+    # default-tier work.
+    "session_id", "user",
 )
 
 
@@ -215,13 +221,21 @@ async def push_handoff(session: aiohttp.ClientSession, peer_url: str,
 
 async def fetch_handoff(session: aiohttp.ClientSession, prefill_url: str,
                         payload: dict, request_id: str, max_bytes: int,
-                        timeout_s: float = HANDOFF_TIMEOUT_S) -> bytes:
+                        timeout_s: float = HANDOFF_TIMEOUT_S,
+                        qos_tier: str = None) -> bytes:
     """POST the handoff request and read the blob with both bounds applied.
     Raises on any non-200, oversized, or timed-out response — the caller
-    falls back to local recompute."""
+    falls back to local recompute. ``qos_tier``: the decode replica's
+    RESOLVED tier, forwarded so a header-classed request keeps its class
+    on the prefill replica (the tenant-key fields in the payload only
+    cover user-pin resolution)."""
+    headers = {REQUEST_ID_HEADER: request_id}
+    if qos_tier is not None:
+        from .errors import QOS_TIER_HEADER
+        headers[QOS_TIER_HEADER] = qos_tier
     async with session.post(
             f"{prefill_url.rstrip('/')}/internal/kv_handoff", json=payload,
-            headers={REQUEST_ID_HEADER: request_id},
+            headers=headers,
             timeout=aiohttp.ClientTimeout(total=timeout_s)) as resp:
         if resp.status != 200:
             # Bounded error peek: the envelope is small; never slurp an

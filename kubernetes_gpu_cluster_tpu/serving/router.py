@@ -104,7 +104,7 @@ from ..utils import get_logger
 # The engine's shed/drain responses use the same envelope (serving.errors):
 # a router-level 503 is handled by the identical client code path.
 from .errors import (MIGRATE_URL_HEADER, PREFILL_URL_HEADER,
-                     REQUEST_ID_HEADER, RESUME_MODE_HEADER,
+                     QOS_TIER_HEADER, REQUEST_ID_HEADER, RESUME_MODE_HEADER,
                      valid_request_id)
 from .errors import overloaded_error as _proxy_error
 
@@ -278,7 +278,9 @@ class Router:
                  ring_vnodes: int = RING_VNODES,
                  trace_timeout_s: float = 5.0,
                  prefill_urls: Optional[list[str]] = None,
-                 failover_attempts: int = FAILOVER_ATTEMPTS):
+                 failover_attempts: int = FAILOVER_ATTEMPTS,
+                 qos_tiers: tuple = (),
+                 qos_default_tier: Optional[str] = None):
         if routing_policy not in ("least-inflight", "prefix-affinity"):
             raise ValueError(f"unknown routing_policy {routing_policy!r} "
                              "(known: least-inflight, prefix-affinity)")
@@ -367,6 +369,21 @@ class Router:
         # chaos replays reproduce (the old shared itertools.count iterator
         # had the same values but no seam to assert or reset around).
         self._pick_seq = 0
+        # Multi-tenant QoS: the router resolves each request's tier with
+        # the SAME order as the replica (config/qos.resolve_tier_name —
+        # imported lazily so a tier-less router stays as light as before),
+        # propagates the resolution upstream in QOS_TIER_HEADER, and keeps
+        # a per-tier in-flight ledger for /health + /metrics (bounded
+        # label set: configured tier names only).
+        self.qos_tiers = tuple(qos_tiers or ())
+        self.qos_default_tier = qos_default_tier
+        self.tier_inflight: dict[str, int] = {
+            t.name: 0 for t in self.qos_tiers}
+        self._resolve_tier_name = self._tenant_key_of = None
+        if self.qos_tiers:
+            from ..config.qos import resolve_tier_name, tenant_key_of
+            self._resolve_tier_name = resolve_tier_name
+            self._tenant_key_of = tenant_key_of
         self._session: Optional[aiohttp.ClientSession] = None
         self._health_task: Optional[asyncio.Task] = None
 
@@ -492,13 +509,16 @@ class Router:
     async def health(self, request: web.Request) -> web.Response:
         healthy = [r.url for r in self.replicas if r.healthy]
         status = 200 if healthy else 503
-        return web.json_response(
-            {"status": "ok" if healthy else "no healthy replicas",
-             "replicas": {r.url: {"healthy": r.healthy,
-                                  "inflight": r.inflight,
-                                  "role": role}
-                          for r, role in self._pools()}},
-            status=status)
+        body = {"status": "ok" if healthy else "no healthy replicas",
+                "replicas": {r.url: {"healthy": r.healthy,
+                                     "inflight": r.inflight,
+                                     "role": role}
+                             for r, role in self._pools()}}
+        if self.qos_tiers:
+            # Per-tier in-flight (fleet view): which tenant class is
+            # loading the pool right now; absent when QoS is off.
+            body["qos_tiers"] = dict(self.tier_inflight)
+        return web.json_response(body, status=status)
 
     async def metrics(self, request: web.Request) -> web.Response:
         # Per-replica gauges carry the POOL role (prefill|decode|both) so
@@ -512,6 +532,14 @@ class Router:
         lines.append("# TYPE kgct_router_replica_inflight gauge")
         lines += [f'kgct_router_replica_inflight{{replica="{r.url}",'
                   f'role="{role}"}} {r.inflight}' for r, role in pools]
+        if self.tier_inflight:
+            # Multi-tenant QoS: per-tier in-flight through this router —
+            # bounded label set (configured tier names), zeros from the
+            # first scrape, absent entirely when QoS is off.
+            lines.append("# TYPE kgct_router_tier_inflight gauge")
+            lines += [f'kgct_router_tier_inflight{{tier="{n}"}} '
+                      f"{self.tier_inflight[n]}"
+                      for n in sorted(self.tier_inflight)]
         lines += ["# TYPE kgct_router_retries_total counter",
                   f"kgct_router_retries_total {self.retries_total}"]
         lines.append("# TYPE kgct_failovers_total counter")
@@ -883,10 +911,18 @@ class Router:
                                and request.method == "POST"
                                and request.path.endswith("/completions")
                                and b'"stream"' in body)
+        # QoS tier resolution needs the tenant key (session_id/user) from
+        # the body — same single parse as every other peek.
+        qos_post = bool(self.qos_tiers
+                        and request.method == "POST"
+                        and request.path.endswith("/completions"))
         obj = self._parse_json_dict(body) \
             if (self.routing_policy == "prefix-affinity" or disagg_post
-                or survivable_post) \
+                or survivable_post or qos_post) \
             else None
+        tier = qos_hdr = None
+        if qos_post:
+            tier, qos_hdr = self._qos_resolve(request, obj)
         akey = self._affinity_key_from_obj(obj) \
             if self.routing_policy == "prefix-affinity" else None
         self.tracer.emit("arrival", rid, path=request.path,
@@ -906,23 +942,49 @@ class Router:
             if pr is not None:
                 self.tracer.emit("pick", rid, replica=pr.url,
                                  pool="prefill", **pf_info)
-        if pr is None:
-            return await self._forward(request, body, rid, akey, None,
-                                       obj=obj)
-        # The handoff pull slot is outstanding on this prefill replica for
-        # the request's lifetime — without the count the prefill pool's
-        # bounded-load overflow could never trigger (every prefill Replica
-        # would read inflight 0 forever) and a hot prefix would pin 100%
-        # of handoffs to one replica, each holding a bounded pull slot,
-        # while the rest of the pool idled. The request span over-estimates
-        # the pull window (decode rides along), which only makes spillover
-        # MORE eager under pile-up — the safe direction.
-        pr.inflight += 1
+        # Per-tier in-flight ledger (QoS): brackets the whole proxied
+        # lifetime, streaming included — the fleet-level view of which
+        # tenant class is loading the pool.
+        if tier is not None:
+            self.tier_inflight[tier] += 1
         try:
-            return await self._forward(request, body, rid, akey, pr.url,
-                                       obj=obj)
+            if pr is None:
+                return await self._forward(request, body, rid, akey, None,
+                                           obj=obj, qos_hdr=qos_hdr)
+            # The handoff pull slot is outstanding on this prefill replica
+            # for the request's lifetime — without the count the prefill
+            # pool's bounded-load overflow could never trigger (every
+            # prefill Replica would read inflight 0 forever) and a hot
+            # prefix would pin 100% of handoffs to one replica, each
+            # holding a bounded pull slot, while the rest of the pool
+            # idled. The request span over-estimates the pull window
+            # (decode rides along), which only makes spillover MORE eager
+            # under pile-up — the safe direction.
+            pr.inflight += 1
+            try:
+                return await self._forward(request, body, rid, akey, pr.url,
+                                           obj=obj, qos_hdr=qos_hdr)
+            finally:
+                pr.inflight -= 1
         finally:
-            pr.inflight -= 1
+            if tier is not None:
+                self.tier_inflight[tier] -= 1
+
+    def _qos_resolve(self, request: web.Request, obj: Optional[dict]
+                     ) -> tuple[Optional[str], Optional[str]]:
+        """(resolved tier, header value to forward) — the router-side half
+        of the one resolution order (config/qos.resolve_tier_name): valid
+        inbound header > tenant-key user pin > default. An INVALID inbound
+        header resolves nothing and is forwarded untouched — the replica
+        owns body/header validation and 400s loudly; the router must not
+        silently re-class a typo'd tier."""
+        tier, err = self._resolve_tier_name(
+            self.qos_tiers, self.qos_default_tier,
+            header=request.headers.get(QOS_TIER_HEADER),
+            tenant_key=self._tenant_key_of(obj))
+        if err is not None:
+            return None, None
+        return tier, tier
 
     def _ring_successor(self, key: bytes, exclude: set) -> Optional[str]:
         """First healthy main-pool replica on the ring walk from ``key``
@@ -942,7 +1004,8 @@ class Router:
     async def _forward(self, request: web.Request, body: bytes, rid: str,
                        akey: Optional[bytes],
                        prefill_hdr: Optional[str],
-                       obj: Optional[dict] = None) -> web.StreamResponse:
+                       obj: Optional[dict] = None,
+                       qos_hdr: Optional[str] = None) -> web.StreamResponse:
         """The failover forwarding loop of :meth:`proxy`, split out so the
         prefill-slot accounting brackets it in one try/finally whatever
         path it returns through. ``obj`` (the parsed body) enables
@@ -1000,15 +1063,23 @@ class Router:
                     if _inject_fault("router_connect"):
                         raise ConnectionRefusedError(
                             "KGCT_FAULT router_connect")
+                    stripped = {REQUEST_ID_HEADER, PREFILL_URL_HEADER,
+                                MIGRATE_URL_HEADER}
+                    if qos_hdr is not None:
+                        # Propagate the ROUTER-resolved tier: both layers
+                        # then attribute this request identically (an
+                        # unresolvable inbound header passes through for
+                        # the replica's loud 400 instead).
+                        stripped.add(QOS_TIER_HEADER)
                     fwd_headers = {
                         k: v for k, v in request.headers.items()
                         if k.lower() not in HOP_HEADERS
-                        and k.lower() not in (REQUEST_ID_HEADER,
-                                              PREFILL_URL_HEADER,
-                                              MIGRATE_URL_HEADER)}
+                        and k.lower() not in stripped}
                     # The replica adopts this as its engine request id, so
                     # its lifecycle trace correlates with the router spans.
                     fwd_headers[REQUEST_ID_HEADER] = rid
+                    if qos_hdr is not None:
+                        fwd_headers[QOS_TIER_HEADER] = qos_hdr
                     if prefill_hdr is not None:
                         # Router-owned (client values stripped above): the
                         # decode replica pulls prefilled KV from here.
@@ -1220,6 +1291,13 @@ class Router:
             exclude.add(target_url)
             target = next(r for r in self.replicas if r.url == target_url)
             headers = {REQUEST_ID_HEADER: rid}
+            if self.qos_tiers:
+                # A header-classed stream keeps its QoS class across the
+                # failover hop (the resume handler can only re-derive the
+                # user-pin/default rungs from the replayed body).
+                _, qos_hdr = self._qos_resolve(request, obj)
+                if qos_hdr is not None:
+                    headers[QOS_TIER_HEADER] = qos_hdr
             nxt = self._ring_successor(key, exclude)
             if nxt is not None:
                 # The resumed stream is itself survivable: name ITS
@@ -1388,13 +1466,41 @@ def main(argv: Optional[list[str]] = None) -> None:
                    "above ceil(factor * mean inflight) spills the request "
                    "to its ring successor (1.0 = strict fair share; larger "
                    "= stickier)")
+    p.add_argument("--qos-tiers", default=None,
+                   help="multi-tenant QoS tier config (same JSON as the "
+                   "engine's --qos-tiers, or 'default'): the router "
+                   "resolves each request's tier (header > session_id/"
+                   "user pin > default), propagates it upstream in "
+                   "x-kgct-qos-tier, and exposes per-tier inflight on "
+                   "/health and /metrics. Unset = tier-less routing, "
+                   "byte-identical to before")
+    p.add_argument("--qos-default-tier", default=None,
+                   help="tier applied to requests that name none; "
+                   "default: the first configured tier")
     args = p.parse_args(argv)
+    qos_tiers: tuple = ()
+    if args.qos_tiers:
+        # Lazy import: a tier-less router never loads the config package.
+        from ..config.qos import parse_qos_tiers
+        try:
+            qos_tiers = parse_qos_tiers(args.qos_tiers)
+        except ValueError as e:
+            p.error(str(e))
+        if (args.qos_default_tier is not None
+                and args.qos_default_tier not in {t.name
+                                                  for t in qos_tiers}):
+            p.error(f"--qos-default-tier {args.qos_default_tier!r} is not "
+                    "a configured tier")
+    elif args.qos_default_tier is not None:
+        p.error("--qos-default-tier requires --qos-tiers")
     router = Router(args.replicas.split(","),
                     routing_policy=args.routing_policy,
                     affinity_prefix_len=args.affinity_prefix_len,
                     balance_factor=args.balance_factor,
                     prefill_urls=(args.prefill_replicas.split(",")
-                                  if args.prefill_replicas else None))
+                                  if args.prefill_replicas else None),
+                    qos_tiers=qos_tiers,
+                    qos_default_tier=args.qos_default_tier)
     web.run_app(router.build_app(), host=args.host, port=args.port)
 
 

@@ -174,6 +174,74 @@ def test_swap_space_knob_maps_to_engine_flag():
     assert "--swap-space-gb" not in args
 
 
+def test_qos_tiers_render_golden():
+    """vllmConfig.qosTiers (+qosDefaultTier) render to one validated
+    --qos-tiers CLI JSON on BOTH the engine and the router (the two layers
+    must resolve tiers identically); absent renders nothing (QoS off,
+    byte-identical manifests)."""
+    import json as _json
+    values = copy.deepcopy(VALUES)
+    cfg = values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"]
+    cfg["qosTiers"] = [
+        {"name": "interactive", "weight": 4, "priority": 10,
+         "maxConcurrent": 64, "ttftBudgetMs": 1000,
+         "users": ["alice"]},
+        {"name": "batch", "weight": 1},
+    ]
+    cfg["qosDefaultTier"] = "interactive"
+    ms = render_values(values)
+    eargs = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    rargs = ms["router-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    ejson = eargs[eargs.index("--qos-tiers") + 1]
+    # Golden pin of the rendered CLI JSON (the engine/router contract).
+    assert _json.loads(ejson) == {
+        "interactive": {"weight": 4.0, "priority": 10,
+                        "max_concurrent": 64, "ttft_budget_ms": 1000.0,
+                        "users": ["alice"]},
+        "batch": {"weight": 1.0, "priority": 0},
+    }
+    assert eargs[eargs.index("--qos-default-tier") + 1] == "interactive"
+    # Router carries the SAME table + default.
+    assert rargs[rargs.index("--qos-tiers") + 1] == ejson
+    assert rargs[rargs.index("--qos-default-tier") + 1] == "interactive"
+    # Absent -> nothing rendered on either layer.
+    ms = render_values(copy.deepcopy(VALUES))
+    for f in ("qwen3-engine-deployment.yaml", "router-deployment.yaml"):
+        args = ms[f]["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--qos-tiers" not in args
+
+
+def test_qos_tiers_validation_fails_render():
+    """Duplicate tier names, unknown keys, a qosDefaultTier naming an
+    unconfigured tier, and a routerSpec/vllmConfig table conflict all fail
+    the RENDER — never the pod at start."""
+    def with_cfg(**kw):
+        values = copy.deepcopy(VALUES)
+        values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"].update(kw)
+        return values
+
+    with pytest.raises(ValueError, match="duplicate qosTiers name"):
+        render_values(with_cfg(qosTiers=[{"name": "a"}, {"name": "a"}]))
+    with pytest.raises(ValueError, match="unknown key"):
+        render_values(with_cfg(qosTiers=[{"name": "a", "wieght": 2}]))
+    with pytest.raises(ValueError, match="not a configured tier"):
+        render_values(with_cfg(qosTiers=[{"name": "a"}],
+                               qosDefaultTier="zz"))
+    with pytest.raises(ValueError, match="qosDefaultTier requires"):
+        render_values(with_cfg(qosDefaultTier="a"))
+    with pytest.raises(ValueError, match="weight"):
+        render_values(with_cfg(qosTiers=[{"name": "a", "weight": 0}]))
+    with pytest.raises(ValueError, match="LIST of tenant keys"):
+        # YAML scalar users would list() into characters
+        render_values(with_cfg(qosTiers=[{"name": "a", "users": "alice"}]))
+    values = with_cfg(qosTiers=[{"name": "a"}])
+    values["routerSpec"] = {"qosTiers": [{"name": "b"}]}
+    with pytest.raises(ValueError, match="contradicts"):
+        render_values(values)
+
+
 def test_quantization_knobs_map_to_engine_flags():
     """vllmConfig.quantization / quantGroupSize render to the API server's
     --quantization / --quant-group-size (the weight-only quant ladder's

@@ -284,12 +284,18 @@ class TestHandoffWireCodec:
         with pytest.raises(ValueError):
             decode_handoff(data[:10])          # truncated header
 
-    def test_request_body_forwards_sampling_fields_only(self):
+    def test_request_body_forwards_sampling_and_tenant_fields_only(self):
+        """Forwarded: the sampling fields that shape the first token plus
+        the QoS tenant keys (user/session_id — the prefill replica
+        resolves the request's tier from them, since the pull carries no
+        client headers). Never forwarded: text prompt (the prefill side
+        must not re-tokenize), stream, max_tokens (clamped to 1 by the
+        handoff handler)."""
         body = {"prompt": "ignored", "temperature": 0.5, "seed": 3,
                 "stream": True, "max_tokens": 99, "user": "u"}
         fwd = handoff_request_body([1, 2], body)
         assert fwd == {"prompt_token_ids": [1, 2], "temperature": 0.5,
-                       "seed": 3}
+                       "seed": 3, "user": "u"}
 
 
 class TestBoundedFetch:

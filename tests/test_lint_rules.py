@@ -824,3 +824,64 @@ class TestMigrationStateSafety:  # KGCT014
             def export_running(seq, inflight):
                 return {"toks": inflight["window_toks"]}
         """, "KGCT014", relpath="serving/api_server.py") == []
+
+
+class TestTenantAccountingSafety:  # KGCT015
+    def test_serving_layer_charge_fires(self):
+        """The regression the rule exists to catch: a serving handler
+        charging a tier's fairness clock 'to help' a tenant — every
+        subsequent weighted-fair decision is then skewed for the life of
+        the process."""
+        found = lint("""
+            class APIServer:
+                async def _run(self, request, tier):
+                    self.engine.scheduler.qos.charge(tier, 512)
+        """, "KGCT015", relpath="serving/api_server.py")
+        assert len(found) == 1 and "fair-share seam" in found[0].message
+
+    def test_direct_clock_write_outside_qos_fires(self):
+        found = lint("""
+            def rebalance(qos):
+                qos.virtual_tokens["batch"] += 100.0
+        """, "KGCT015", relpath="engine/engine.py")
+        assert len(found) == 1 and "virtual_tokens" in found[0].message
+
+    def test_sync_active_from_bench_fires(self):
+        found = lint("""
+            def warm(engine):
+                engine.scheduler.qos.sync_active(["interactive"])
+        """, "KGCT015", relpath="observability/__init__.py")
+        assert len(found) == 1
+
+    def test_scheduler_seam_charge_silent(self):
+        assert lint("""
+            class Scheduler:
+                def _qos_charge_batch(self, batch):
+                    for seq in batch.seqs:
+                        self.qos.charge(seq.params.qos_tier, 8)
+        """, "KGCT015", relpath="engine/scheduler.py") == []
+
+    def test_mixed_batch_seam_silent(self):
+        assert lint("""
+            def build_mixed_batch(sched):
+                sched.qos.charge("batch", 1)
+        """, "KGCT015", relpath="engine/mixed_batch.py") == []
+
+    def test_clock_write_inside_qos_module_silent(self):
+        assert lint("""
+            class QoSAccounting:
+                def charge(self, name, tokens):
+                    self.virtual_tokens[name] += tokens / 2.0
+                    self.served_tokens[name] += tokens
+        """, "KGCT015", relpath="engine/qos.py") == []
+
+    def test_reads_and_other_accounting_silent(self):
+        """Snapshot READS and the serving-side admission ledger
+        (tier_inflight — a different mechanism with its own accounting
+        pair) stay silent."""
+        assert lint("""
+            def render(qos, adm):
+                vt = dict(qos.virtual_tokens)
+                adm.tier_inflight["batch"] += 1
+                return vt
+        """, "KGCT015", relpath="serving/metrics.py") == []

@@ -1,0 +1,659 @@
+"""Multi-tenant QoS: weighted fair scheduling, priority preemption,
+per-tier admission budgets, and the QoS-off byte-identity contract.
+
+Scheduler-level tests construct Scheduler directly (no jit, ~ms each);
+engine-level pins share ONE module-scoped debug-tiny engine pair for the
+tier-1 budget. The tenant_flood chaos test drives the admission ledger
+directly (engine-free of device work).
+"""
+
+import dataclasses
+
+import pytest
+
+from kubernetes_gpu_cluster_tpu.config import (CacheConfig, EngineConfig,
+                                               QoSTier, SchedulerConfig,
+                                               get_model_config)
+from kubernetes_gpu_cluster_tpu.config.qos import (parse_qos_tiers,
+                                                   resolve_tier_name,
+                                                   tiers_to_json)
+from kubernetes_gpu_cluster_tpu.engine import LLMEngine, SamplingParams
+from kubernetes_gpu_cluster_tpu.engine.scheduler import Scheduler
+from kubernetes_gpu_cluster_tpu.engine.sequence import Sequence
+
+TIERS = (QoSTier("interactive", weight=4.0, priority=10),
+         QoSTier("batch", weight=1.0, priority=0))
+
+
+def _cfg(num_pages=64, page_size=4, max_num_seqs=4, decode_window=1,
+         max_prefill_tokens=64, qos=True, mixed=False, swap_gb=0.0):
+    return EngineConfig(
+        model=get_model_config("debug-tiny"),
+        cache=CacheConfig(page_size=page_size, num_pages=num_pages,
+                          swap_space_gb=swap_gb),
+        scheduler=SchedulerConfig(
+            max_num_seqs=max_num_seqs,
+            max_prefill_tokens=max_prefill_tokens,
+            decode_buckets=(1, 2, 4, 8),
+            prefill_buckets=(16, 32, 64),
+            decode_window=decode_window,
+            mixed_batch_enabled=mixed,
+            qos_tiers=TIERS if qos else ()))
+
+
+def _seq(rid, n_prompt, tier=None, max_tokens=64):
+    return Sequence(rid, list(range(1, n_prompt + 1)),
+                    SamplingParams(max_tokens=max_tokens, qos_tier=tier))
+
+
+class TestTierParsing:
+    def test_default_literal_and_round_trip(self):
+        tiers = parse_qos_tiers("default")
+        assert [t.name for t in tiers] == ["interactive", "batch"]
+        assert tiers[0].weight == 4.0 and tiers[0].priority == 10
+        # tiers_to_json -> parse_qos_tiers round-trips (the renderer path)
+        assert parse_qos_tiers(tiers_to_json(tiers)) == tiers
+
+    def test_empty_disables(self):
+        assert parse_qos_tiers(None) == ()
+        assert parse_qos_tiers("") == ()
+
+    def test_validation_failures(self):
+        with pytest.raises(ValueError, match="label"):
+            parse_qos_tiers('{"bad name!": {}}')
+        with pytest.raises(ValueError, match="unknown key"):
+            parse_qos_tiers('{"a": {"wieght": 2}}')
+        with pytest.raises(ValueError, match="weight"):
+            parse_qos_tiers('{"a": {"weight": 0}}')
+        with pytest.raises(ValueError, match="max_concurrent"):
+            parse_qos_tiers('{"a": {"max_concurrent": 0}}')
+        with pytest.raises(ValueError, match="pinned to both"):
+            parse_qos_tiers('{"a": {"users": ["u"]}, '
+                            '"b": {"users": ["u"]}}')
+        with pytest.raises(ValueError, match="JSON"):
+            parse_qos_tiers("{nope")
+
+    def test_resolution_order(self):
+        tiers = parse_qos_tiers(
+            '{"vip": {"priority": 5, "users": ["alice"]}, "std": {}}')
+        # header beats user pin beats default (= first tier)
+        assert resolve_tier_name(tiers, None, header="std",
+                                 tenant_key="alice") == ("std", None)
+        assert resolve_tier_name(tiers, None,
+                                 tenant_key="alice") == ("vip", None)
+        assert resolve_tier_name(tiers, None,
+                                 tenant_key="bob") == ("vip", None)
+        assert resolve_tier_name(tiers, "std",
+                                 tenant_key="bob") == ("std", None)
+        name, err = resolve_tier_name(tiers, None, header="nope")
+        assert name is None and "unknown qos tier" in err
+        # QoS off: nothing resolves, header ignored
+        assert resolve_tier_name((), None, header="x") == (None, None)
+
+    def test_sampling_params_state_round_trip(self):
+        p = SamplingParams(max_tokens=4, qos_tier="batch")
+        assert SamplingParams.from_state(p.to_state()).qos_tier == "batch"
+        with pytest.raises(ValueError, match="qos_tier"):
+            SamplingParams(qos_tier=7)
+
+
+class TestFairShareScheduling:
+    def test_promotion_ahead_of_queued_batch(self):
+        """An interactive request queued behind batch prompts is promoted
+        to the head (weighted fair admission), FCFS within each tier."""
+        s = Scheduler(_cfg(max_num_seqs=8), 64)
+        for i in range(3):
+            s.add(_seq(f"b{i}", 8, "batch"))
+        s.add(_seq("chat0", 8, "interactive"))
+        s.add(_seq("chat1", 8, "interactive"))
+        batch = s.schedule()
+        ids = [x.request_id for x in batch.seqs]
+        assert ids[0] == "chat0"                 # promoted, FCFS in-tier
+        assert ids.index("chat0") < ids.index("chat1")
+
+    def test_weighted_interleaving_no_starvation(self):
+        """With both tiers continuously backlogged and one admission slot
+        per round, service follows the 4:1 weights — and batch is never
+        starved (its clock falls behind and wins the comparison)."""
+        s = Scheduler(_cfg(max_num_seqs=1, num_pages=256), 256)
+        order = []
+        backlog = {"interactive": 0, "batch": 0}
+
+        def refill():
+            for tier in ("interactive", "batch"):
+                while backlog[tier] < 2:
+                    rid = f"{tier[0]}{len(order) + backlog[tier]}-{tier}"
+                    s.add(_seq(rid, 8, tier, max_tokens=1))
+                    backlog[tier] += 1
+
+        for _ in range(30):
+            refill()
+            batch = s.schedule()
+            assert batch is not None and batch.kind == "prefill"
+            seq = batch.seqs[0]
+            tier = seq.params.qos_tier
+            order.append(tier)
+            backlog[tier] -= 1
+            # retire immediately: frees the single seat for the next round
+            s.finish(seq, __import__(
+                "kubernetes_gpu_cluster_tpu.engine.sequence",
+                fromlist=["FinishReason"]).FinishReason.LENGTH)
+        n_int = order.count("interactive")
+        n_bat = order.count("batch")
+        assert n_bat >= 3, f"batch starved: {order}"
+        # 4:1 weights with equal-size requests -> ~4:1 service split
+        assert 2.0 <= n_int / n_bat <= 8.0, order
+
+    def test_chunk_defer_bounds_interactive_wait(self):
+        """Deficit bound: a batch-tier long prompt mid-chunk yields the
+        prefill budget to a newly arrived interactive request — the
+        interactive prefill schedules next, not after every remaining
+        chunk."""
+        s = Scheduler(_cfg(max_prefill_tokens=16, num_pages=64), 64)
+        s.add(_seq("long-batch", 48, "batch"))        # 3 chunks of 16
+        b1 = s.schedule()
+        assert b1.kind == "prefill" and b1.partial    # chunk 1 of 3
+        s.add(_seq("chat", 8, "interactive"))
+        b2 = s.schedule()
+        assert [x.request_id for x in b2.seqs] == ["chat"]
+        # the batch head kept its pages and resumes chunking afterwards
+        assert s.waiting[0].request_id == "long-batch"
+        assert s.waiting[0].num_prefilled == 16
+        b3 = s.schedule()
+        assert b3.seqs[0].request_id == "long-batch"
+
+    def test_deferred_prefix_held_head_keeps_its_pages(self):
+        """Review regression: a prefix-cache-hit head (num_prefilled > 0,
+        holding refcounted cache pages, prompt small enough to pack) whose
+        chunk is QoS-deferred must NOT be admitted by the lookahead loop
+        as a full prefill — that would overwrite seq.pages and leak the
+        cached pages. It advances only through the chunk path once the
+        defer gate releases."""
+        cfg = EngineConfig(
+            model=get_model_config("debug-tiny"),
+            cache=CacheConfig(page_size=4, num_pages=64),
+            scheduler=SchedulerConfig(
+                max_num_seqs=8, max_prefill_tokens=64,
+                decode_buckets=(1, 2, 4, 8), prefill_buckets=(16, 32, 64),
+                decode_window=1, mixed_batch_enabled=False,
+                enable_prefix_caching=True, qos_tiers=TIERS))
+        cfg = dataclasses.replace(
+            cfg, scheduler=dataclasses.replace(cfg.scheduler,
+                                               max_num_seqs=1))
+        s = Scheduler(cfg, 64)
+        from kubernetes_gpu_cluster_tpu.engine.sequence import FinishReason
+
+        def seq_with(rid, toks, tier):
+            return Sequence(rid, toks,
+                            SamplingParams(max_tokens=64, qos_tier=tier))
+
+        # Warm the prefix cache with a batch prompt, drain it fully.
+        warm = seq_with("warm", list(range(1, 13)), "batch")
+        s.add(warm)
+        assert s.schedule().kind == "prefill"
+        s.finish(warm, FinishReason.LENGTH)
+        # A batch occupier holds the ONLY seat, so the same-prefix batch
+        # head's cache hit (pages + num_prefilled>0) happens while its
+        # final chunk is seat-blocked — the prefix-held-head-at-waiting[0]
+        # state the defer gate then sees.
+        occ = seq_with("occ", list(range(50, 58)), "batch")
+        s.add(occ)
+        assert s.schedule().kind == "prefill"
+        bat = seq_with("bat", list(range(1, 13)), "batch")
+        s.add(bat)
+        assert s.schedule().kind == "decode"        # occ decodes; bat blocked
+        assert bat.num_prefilled > 0 and bat.pages  # cache hit held
+        held = list(bat.pages)
+        s.finish(occ, FinishReason.LENGTH)          # seat frees
+        chat = seq_with("chat", list(range(100, 108)), "interactive")
+        s.add(chat)
+        batch = s.schedule()
+        ids = [x.request_id for x in batch.seqs]
+        assert ids == ["chat"]                      # defer fired, chat first
+        assert bat.pages == held                    # held pages untouched
+        s.finish(chat, FinishReason.LENGTH)
+        # gate releases: the batch head finishes through the chunk path
+        nxt = s.schedule()
+        assert [x.request_id for x in nxt.seqs] == ["bat"]
+        assert bat.pages[:len(held)] == held
+
+    def test_chunkable_waiter_never_deadlocks_the_chunk_gate(self):
+        """Review regression: when the owed higher-priority waiter is
+        ITSELF chunkable (prompt > max_prefill_tokens), deferring the
+        mid-chunk head would schedule neither sequence and freeze both
+        clocks — a permanent stall. The gate must not fire: the head
+        keeps chunking, then the waiter runs, and both finish."""
+        s = Scheduler(_cfg(max_prefill_tokens=16, num_pages=64), 64)
+        s.add(_seq("long-batch", 48, "batch", max_tokens=1))
+        assert s.schedule().partial          # chunk 1 of 3, mid-chunk head
+        s.add(_seq("long-chat", 40, "interactive", max_tokens=1))
+        scheduled = []
+        for _ in range(12):
+            batch = s.schedule()
+            if batch is None:
+                break
+            scheduled.append(batch.seqs[0].request_id)
+            for seq in batch.seqs:
+                if (seq in s.running
+                        and seq.num_prefilled >= seq.num_tokens):
+                    seq.append_token(1)      # simulate its one token
+                    from kubernetes_gpu_cluster_tpu.engine.sequence import (
+                        FinishReason)
+                    s.finish(seq, FinishReason.LENGTH)
+        assert not s.has_work(), f"stalled with work queued: {scheduled}"
+        assert {"long-batch", "long-chat"} <= set(scheduled)
+
+    def test_idle_tier_banks_no_credit_even_reactivating_alone(self):
+        """Review regression: a tier re-activating while NO settled tier
+        remains active must still floor to the monotone system virtual
+        time, not keep the stale low clock it banked while idle."""
+        from kubernetes_gpu_cluster_tpu.engine.qos import QoSAccounting
+        q = QoSAccounting(TIERS)
+        q.sync_active(["interactive", "batch"])
+        q.charge("interactive", 4000)        # w=4 -> clock 1000
+        q.charge("batch", 100)               # w=1 -> clock 100
+        q.sync_active(["interactive"])       # batch goes idle; vtime=100
+        q.charge("interactive", 16000)       # clock 5000; batch idle
+        q.sync_active(["interactive"])       # vtime high-waters to 5000
+        q.sync_active([])                    # everyone idle
+        q.sync_active(["batch"])             # batch re-enters ALONE
+        assert q.virtual_tokens["batch"] >= 5000.0
+        # interactive returning is never punished below its own clock
+        q.sync_active(["interactive", "batch"])
+        assert q.virtual_tokens["interactive"] == 5000.0
+
+    def test_idle_departure_observed_during_waiting_empty_stretch(self):
+        """Review regression: sync_active must run on EVERY schedule()
+        call (waiting-empty decode stretches included) — otherwise a
+        tier's departure is never observed, and its later return skips
+        the idle catch-up and spends arbitrarily large banked credit."""
+        s = Scheduler(_cfg(num_pages=256, decode_window=4), 256)
+        bat = _seq("bat", 8, "batch", max_tokens=64)
+        s.add(bat)
+        assert s.schedule().kind == "prefill"
+        # Pure-decode stretch with waiting EMPTY: batch's clock charges
+        # far ahead while no other tier has work.
+        for _ in range(10):
+            bat.append_token(3)
+            assert s.schedule().kind == "decode"
+        vt_batch = s.qos.virtual_tokens["batch"]
+        assert vt_batch > 8
+        # Interactive re-enters AFTER the stretch: it must floor to the
+        # system virtual time (~batch's clock), not its stale 0.
+        s.add(_seq("chat", 8, "interactive"))
+        s.schedule()
+        assert s.qos.virtual_tokens["interactive"] >= vt_batch - 4 - 1
+
+    def test_make_room_preempts_batch_for_interactive(self):
+        """Seats full of batch-tier decodes: an interactive arrival evicts
+        the youngest batch sequence (recompute here; swap when the host
+        tier is on) and the victim requeues BEHIND its beneficiary."""
+        s = Scheduler(_cfg(max_num_seqs=2), 64)
+        s.add(_seq("b0", 8, "batch"))
+        s.add(_seq("b1", 8, "batch"))
+        assert s.schedule().kind == "prefill"
+        s.add(_seq("chat", 8, "interactive"))
+        batch = s.schedule()
+        assert any(x.request_id == "chat" for x in batch.seqs)
+        assert s.num_preemptions_by_kind["recompute"] == 1
+        # victim (youngest batch) sits behind the interactive beneficiary
+        assert [q.request_id for q in s.waiting] == ["b1"]
+
+    def test_same_tier_never_preempts_for_admission(self):
+        """Within one tier the no-preempt-for-admission invariant holds:
+        a batch arrival never evicts running batch work."""
+        s = Scheduler(_cfg(max_num_seqs=2), 64)
+        s.add(_seq("b0", 8, "batch"))
+        s.add(_seq("b1", 8, "batch"))
+        s.schedule()
+        s.add(_seq("b2", 8, "batch"))
+        batch = s.schedule()
+        assert batch.kind == "decode"
+        assert s.num_preemptions == 0
+
+    def test_decode_growth_victim_is_batch_not_interactive(self):
+        """Page-pressure preemption picks the batch-tier victim even when
+        an interactive sequence is the youngest admission."""
+        cfg = _cfg(num_pages=5, page_size=2, max_num_seqs=4)  # 4 usable
+        s = Scheduler(cfg, 5)
+        b, a = _seq("bat", 2, "batch"), _seq("int", 2, "interactive")
+        s.add(b)
+        s.add(a)        # interactive admitted LAST (= legacy victim)
+        assert s.schedule().kind == "prefill"     # 1 page each, 2 free
+        b.append_token(5)
+        a.append_token(6)
+        b.append_token(5)
+        a.append_token(6)
+        b.append_token(5)
+        a.append_token(6)
+        # both need a 2nd and 3rd page; pool can't fit both -> preempt
+        batch = s.schedule()
+        assert batch is not None
+        assert b.request_id not in [x.request_id for x in batch.seqs]
+        assert s.num_preemptions == 1
+        assert s.waiting and s.waiting[0].request_id == "bat"
+
+    def test_batch_requester_never_evicts_interactive(self):
+        """A lower-priority sequence must stop growing rather than evict a
+        higher-priority one (interactive only preempted by its own
+        tier)."""
+        cfg = _cfg(num_pages=5, page_size=2, max_num_seqs=4)
+        s = Scheduler(cfg, 5)
+        a, b = _seq("int", 2, "interactive"), _seq("bat", 2, "batch")
+        s.add(a)
+        s.add(b)        # batch youngest -> it is the only eligible victim
+        s.schedule()
+        for _ in range(3):
+            a.append_token(6)
+            b.append_token(5)
+        batch = s.schedule()
+        # under pressure the batch seq self-evicts (its own tier), never
+        # the interactive one
+        assert batch is not None
+        assert a.request_id in [x.request_id for x in batch.seqs]
+        assert s.num_preemptions == 1
+        assert s.waiting[0].request_id == "bat"
+
+    def test_qos_off_has_no_accounting(self):
+        """No tiers configured -> scheduler.qos is None and params carrying
+        a qos_tier are inert (the byte-identity contract's structural
+        half)."""
+        s = Scheduler(_cfg(qos=False), 64)
+        assert s.qos is None
+        s.add(_seq("x", 8, "interactive"))
+        assert s.schedule() is not None
+
+
+# -- engine-level pins (shared module engines, tier-1 budget) ---------------
+
+@pytest.fixture(scope="module")
+def qos_engine():
+    return LLMEngine(_cfg(num_pages=128, max_num_seqs=4, decode_window=2,
+                          max_prefill_tokens=16, qos=True),
+                     eos_token_id=None)
+
+
+def _drain(engine):
+    outs = {}
+    order = []
+    while engine.has_unfinished_requests():
+        for o in engine.step():
+            if o.new_token_ids and o.request_id not in order:
+                order.append(o.request_id)
+            outs[o.request_id] = o       # keep the LAST (finished) output
+    return outs, order
+
+
+class TestEngineFairness:
+    def test_interactive_first_token_beats_mid_chunk_batch(self, qos_engine):
+        """Engine-level deficit-bound pin: a batch-tier long prompt
+        (chunked across 3 prefill steps) cannot push an interactive
+        arrival's first schedule past its deficit bound — the interactive
+        request's FIRST token lands before the batch request's."""
+        eng = qos_engine
+        eng.add_request("long-batch", list(range(1, 49)),
+                        SamplingParams(max_tokens=4, temperature=0.0,
+                                       qos_tier="batch"))
+        eng.step()                     # chunk 1 of [0:16) committed
+        eng.add_request("chat", [7, 8, 9],
+                        SamplingParams(max_tokens=4, temperature=0.0,
+                                       qos_tier="interactive"))
+        outs, first_token_order = _drain(eng)
+        assert set(outs) == {"long-batch", "chat"}
+        assert all(o.finished for o in outs.values())
+        assert first_token_order[0] == "chat"
+        # the deferred batch chunk resumed and completed unharmed
+        assert len(outs["long-batch"].output_token_ids) == 4
+
+    def test_batch_victim_selected_before_interactive(self, qos_engine):
+        """Engine-level preemption-order pin: under page pressure the
+        batch-tier sequence is the victim, never the younger interactive
+        one — and everyone still finishes (reset-then-converge)."""
+        eng = LLMEngine(_cfg(num_pages=7, page_size=4, max_num_seqs=4,
+                             decode_window=2, qos=True), eos_token_id=None)
+        eng.add_request("bat", [1, 2, 3, 4],
+                        SamplingParams(max_tokens=20, temperature=0.0,
+                                       qos_tier="batch"))
+        eng.add_request("int", [5, 6, 7, 8],
+                        SamplingParams(max_tokens=20, temperature=0.0,
+                                       qos_tier="interactive"))
+        outs, _ = _drain(eng)
+        assert all(len(o.output_token_ids) == 20 for o in outs.values())
+        kinds = [(e.request_id, e.kind)
+                 for e in eng.obs.tracer.events() if e.kind == "preempt"]
+        assert kinds, "expected page-pressure preemptions"
+        assert all(rid == "bat" for rid, _ in kinds)
+
+    def test_per_tier_slo_and_metrics_zero_safe(self, qos_engine):
+        """A QoS engine renders the tier-labeled series (bounded to the
+        configured names) and they are zeros/1.0-safe whatever has run."""
+        from kubernetes_gpu_cluster_tpu.serving.metrics import Metrics
+        text = Metrics(qos_engine).render()
+        assert 'kgct_slo_ttft_attainment_ratio{tier="interactive"}' in text
+        assert 'kgct_slo_ttft_attainment_ratio{tier="batch"}' in text
+        assert 'kgct_qos_requests_finished_total{tier="batch"}' in text
+        assert "nan" not in text
+        # bounded cardinality: only configured names appear as tier labels
+        import re
+        labels = set(re.findall(r'tier="([^"]+)"', text))
+        assert labels == {"interactive", "batch"}
+
+    def test_tierless_engine_renders_no_tier_labels(self):
+        from kubernetes_gpu_cluster_tpu.serving.metrics import Metrics
+        eng = LLMEngine(_cfg(qos=False), eos_token_id=None)
+        assert 'tier="' not in Metrics(eng).render()
+
+    def test_tier_slo_falls_back_to_operator_admission_bar(self):
+        """Review regression: a tier without its own ttft_budget_ms must
+        grade against the OPERATOR's admission default (the bar the
+        global tracker and per-tier admission use), not the hardcoded
+        north-star default."""
+        from kubernetes_gpu_cluster_tpu.observability import Observability
+        obs = Observability()
+        obs.configure_qos_tiers(
+            (QoSTier("strict", ttft_budget_ms=100.0), QoSTier("lax")),
+            "strict", fallback_budget_ms=5000.0)
+        assert obs.slo_by_tier["strict"].budget_ms == 100.0
+        assert obs.slo_by_tier["lax"].budget_ms == 5000.0
+        # no operator default -> the north-star default, same as global
+        obs.configure_qos_tiers((QoSTier("lax"),), "lax")
+        assert obs.slo_by_tier["lax"].budget_ms == obs.slo.budget_ms
+
+
+class TestByteIdentity:
+    def test_uniform_tier_qos_matches_qos_off(self):
+        """Byte-identity pin: with every request in ONE uniform tier the
+        QoS machinery must be a no-op — greedy AND seeded-sampled outputs
+        (penalties included), preemption counts, and step-kind totals all
+        equal the tier-less engine's on an identical page-pressured
+        workload. Together with the structural pin (no tiers -> qos is
+        None -> no QoS branch runs) this pins QoS-off behavior to the
+        pre-QoS scheduler."""
+        one_tier = (QoSTier("only", weight=1.0, priority=0),)
+        outs = {}
+        kinds = {}
+        for label, tiers in (("off", ()), ("on", one_tier)):
+            cfg = EngineConfig(
+                model=get_model_config("debug-tiny"),
+                cache=CacheConfig(page_size=8, num_pages=8),
+                scheduler=SchedulerConfig(
+                    max_num_seqs=4, max_prefill_tokens=256,
+                    decode_buckets=(1, 2, 4, 8),
+                    prefill_buckets=(32, 64, 128, 256),
+                    qos_tiers=tiers))
+            eng = LLMEngine(cfg, eos_token_id=None)
+            assert (eng.scheduler.qos is None) == (label == "off")
+            prompts = [[9, 8, 7, 6], [1, 2, 3, 4], [5, 5, 5, 5]]
+            params = [
+                SamplingParams(max_tokens=16, temperature=0.8, seed=11,
+                               frequency_penalty=1.5,
+                               presence_penalty=0.5,
+                               qos_tier="only" if tiers else None),
+                SamplingParams(max_tokens=16, temperature=0.8, seed=22,
+                               qos_tier="only" if tiers else None),
+                SamplingParams(max_tokens=16, temperature=0.0,
+                               qos_tier="only" if tiers else None),
+            ]
+            outs[label] = [o.output_token_ids
+                           for o in eng.generate(prompts, params)]
+            kinds[label] = (dict(eng.obs.step_kind_counts),
+                            eng.scheduler.num_preemptions)
+            assert eng.scheduler.num_preemptions > 0  # pressured workload
+        assert outs["on"] == outs["off"]
+        assert kinds["on"] == kinds["off"]
+
+
+# -- admission budgets + tenant_flood chaos ---------------------------------
+
+class TestTierAdmission:
+    def _admission(self, engine):
+        from kubernetes_gpu_cluster_tpu.resilience.deadline import (
+            AdmissionController)
+        adm = AdmissionController(engine)
+        adm.configure_tiers(
+            (QoSTier("interactive", weight=4, priority=10,
+                     max_concurrent=8),
+             QoSTier("batch", weight=1, priority=0, max_concurrent=2)),
+            "interactive")
+        return adm
+
+    def test_max_concurrent_sheds_only_its_tier(self, qos_engine):
+        adm = self._admission(qos_engine)
+        adm.on_admit("batch")
+        adm.on_admit("batch")
+        assert adm.check(None, tier="batch") is not None    # at budget
+        assert adm.check(None, tier="interactive") is None  # untouched
+        assert adm.shed_by_tier == {"interactive": 0, "batch": 1}
+        adm.on_release("batch")
+        assert adm.check(None, tier="batch") is None        # budget freed
+
+    def test_tier_ttft_budget_applies_without_header(self, qos_engine):
+        from kubernetes_gpu_cluster_tpu.resilience.deadline import (
+            AdmissionController)
+        from kubernetes_gpu_cluster_tpu.resilience.faults import (
+            configure_faults)
+        adm = AdmissionController(qos_engine)
+        adm.configure_tiers(
+            (QoSTier("strict", ttft_budget_ms=100.0),), "strict")
+        configure_faults("queue_wait_est:value=30")
+        try:
+            # tier budget (100 ms) < forced 30 s estimate -> shed, and the
+            # shed is attributed to the tier
+            ra = adm.check(None, tier="strict")
+            assert ra is not None and ra >= 1
+            assert adm.shed_by_tier["strict"] == 1
+            # an explicit per-request budget still wins over the tier's
+            assert adm.check(120000.0, tier="strict") is None
+        finally:
+            configure_faults(None)
+
+    @pytest.mark.chaos
+    def test_tenant_flood_isolated_to_batch_tier(self, qos_engine):
+        """The tenant_flood chaos site inflates the LOWEST-priority tier's
+        offered load past its budget: every batch check sheds, the
+        interactive tier's shed count stays 0, and the hub's per-tier
+        series carries the attribution."""
+        from kubernetes_gpu_cluster_tpu.resilience import ResilienceHub
+        from kubernetes_gpu_cluster_tpu.resilience.drain import DrainState
+        from kubernetes_gpu_cluster_tpu.resilience.faults import (
+            configure_faults)
+        from kubernetes_gpu_cluster_tpu.resilience.watchdog import (
+            StepWatchdog)
+        adm = self._admission(qos_engine)
+        configure_faults("tenant_flood:value=8")
+        try:
+            for _ in range(5):
+                assert adm.check(None, tier="batch") is not None
+                assert adm.check(None, tier="interactive") is None
+        finally:
+            configure_faults(None)
+        assert adm.shed_by_tier == {"interactive": 0, "batch": 5}
+        wd = StepWatchdog(timeout_s=1000)
+        lines = ResilienceHub(adm, wd, DrainState()).render_prometheus()
+        text = "\n".join(lines)
+        assert 'kgct_requests_shed_total{tier="batch"} 5' in text
+        assert 'kgct_requests_shed_total{tier="interactive"} 0' in text
+        assert "kgct_requests_shed_total 5" in text
+
+
+class TestKVHandoffTierGate:
+    def test_handoff_gate_attributes_to_forwarded_tier(self):
+        """Review regression: the /internal/kv_handoff admission gate must
+        run against the tier the decode replica forwarded (header >
+        tenant key > default), never the default tier — a batch-classed
+        pull's shed lands on the batch ledger."""
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from kubernetes_gpu_cluster_tpu.resilience.faults import (
+            configure_faults)
+        from kubernetes_gpu_cluster_tpu.serving.api_server import (
+            build_server)
+        tiers = (QoSTier("interactive", weight=4, priority=10),
+                 QoSTier("batch", weight=1, priority=0, max_concurrent=2))
+        cfg = dataclasses.replace(
+            _cfg(qos=False),
+            scheduler=dataclasses.replace(_cfg().scheduler,
+                                          qos_tiers=tiers))
+        server = build_server(cfg)
+
+        async def scenario():
+            client = TestClient(TestServer(server.build_app()))
+            await client.start_server()
+            try:
+                configure_faults("tenant_flood:value=8")
+                r = await client.post(
+                    "/internal/kv_handoff",
+                    json={"prompt_token_ids": [1, 2, 3]},
+                    headers={"x-kgct-qos-tier": "batch"})
+                assert r.status == 429
+                # the shed is the BATCH tier's, not the default's
+                assert server.admission.shed_by_tier == {
+                    "interactive": 0, "batch": 1}
+                # interactive-classed pulls stay admitted under the flood
+                r2 = await client.post(
+                    "/internal/kv_handoff",
+                    json={"prompt_token_ids": [1, 2, 3]},
+                    headers={"x-kgct-qos-tier": "interactive"})
+                assert r2.status == 200
+            finally:
+                configure_faults(None)
+                await client.close()
+
+        asyncio.run(scenario())
+
+
+# -- router tier resolution + ledger (engine-free) --------------------------
+
+class TestRouterQoS:
+    def _router(self):
+        from kubernetes_gpu_cluster_tpu.serving.router import Router
+        return Router(["http://a", "http://b"],
+                      qos_tiers=parse_qos_tiers(
+                          '{"vip": {"priority": 5, "users": ["alice"]}, '
+                          '"std": {}}'))
+
+    def test_resolution_and_propagation(self):
+        class Req:
+            def __init__(self, headers):
+                self.headers = headers
+        r = self._router()
+        # valid header wins and is propagated as-is
+        assert r._qos_resolve(Req({"x-kgct-qos-tier": "std"}),
+                              {"user": "alice"}) == ("std", "std")
+        # user pin resolves when no header
+        assert r._qos_resolve(Req({}), {"user": "alice"}) == ("vip", "vip")
+        # default tier (first configured) otherwise
+        assert r._qos_resolve(Req({}), {"user": "bob"}) == ("vip", "vip")
+        # invalid header: nothing resolved, nothing propagated (the
+        # replica's loud 400 to give)
+        assert r._qos_resolve(Req({"x-kgct-qos-tier": "nope"}),
+                              None) == (None, None)
+
+    def test_tier_inflight_metrics_zero_safe(self):
+        r = self._router()
+        assert r.tier_inflight == {"vip": 0, "std": 0}
+        # a tier-less router carries no ledger and renders no tier series
+        from kubernetes_gpu_cluster_tpu.serving.router import Router
+        assert Router(["http://a"]).tier_inflight == {}
