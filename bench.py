@@ -169,6 +169,23 @@ DRAIN_BENCH = os.environ.get("KGCT_BENCH_DRAIN", "1") != "0"
 DRAIN_SESSIONS = int(os.environ.get("KGCT_BENCH_DRAIN_SESSIONS", 6))
 DRAIN_MAX_NEW = int(os.environ.get("KGCT_BENCH_DRAIN_MAX_NEW", 48))
 
+# Fleet-cache phase (serving/fleet_cache.py — global prefix cache over the
+# handoff substrate): shared-prefix sessions warmed on an OWNER replica and
+# then forced onto a NON-OWNER (the router's affinity-overflow case: the
+# owner is over-bound, the pick lands elsewhere and carries the
+# x-kgct-prefix-source hint). A/B on identically-seeded replica pairs:
+# fleet cache ON pulls the owner's cached prefix into the non-owner's
+# cache (streamed import, roofline-gated); OFF recomputes the full prefix.
+# Headline ``fleet_prefix_pull_over_recompute_ttft`` = pull-arm warm TTFT
+# p50 / recompute-arm's (< 1 = pulling beats re-prefilling). Always
+# debug-tiny engines, like every multi-replica phase.
+# KGCT_BENCH_FLEET_CACHE=0 skips.
+FLEET_BENCH = os.environ.get("KGCT_BENCH_FLEET_CACHE", "1") != "0"
+FLEET_SESSIONS = int(os.environ.get("KGCT_BENCH_FLEET_SESSIONS", 3))
+# Shared-prefix length: long enough that the recompute arm's full prefill
+# clearly exceeds one localhost pull + tail chunk on CPU.
+FLEET_SHARED = int(os.environ.get("KGCT_BENCH_FLEET_SHARED", 384))
+
 # Multi-tenant QoS phase (engine/qos.py): a mixed chat+batch workload at
 # SATURATION — batch-tier jobs hold every scheduler seat while short
 # interactive requests arrive one at a time — A/B'd on identically-seeded
@@ -1239,6 +1256,157 @@ def _measure_router() -> dict:
     return out
 
 
+def _measure_fleet_cache() -> dict:
+    """KGCT_BENCH_FLEET_CACHE phase: fleet-wide KV reuse A/B through the
+    real serving stack — an OWNER replica whose prefix cache holds each
+    session's shared prefix and a NON-OWNER replica the sessions are
+    forced onto (requests go DIRECTLY to the non-owner carrying the
+    x-kgct-prefix-source hint the router's overflow path would set,
+    which also exercises the --peer-pool allowlist):
+
+    - arm "pull" (fleet cache on): the non-owner pulls the owner's cached
+      prefix pages over /internal/fetch_prefix, streams them into its own
+      cache, and prefills only the unique tail;
+    - arm "recompute" (fleet cache off): the hint is ignored and the
+      non-owner re-prefills the whole prefix — today's behavior.
+
+    Both arms run identically-seeded engines and identical prompts; both
+    replicas are warmed directly (full-prefill + cached-history programs
+    compiled everywhere, plus one discarded pulled session in the pull
+    arm so the transfer scatter's compile is not timed). Headline:
+    pull-arm warm TTFT p50 / recompute-arm's."""
+    import asyncio
+
+    import aiohttp
+    from aiohttp import web as aioweb
+
+    from kubernetes_gpu_cluster_tpu.serving.api_server import build_server
+    from kubernetes_gpu_cluster_tpu.serving.errors import PREFIX_SOURCE_HEADER
+
+    on_tpu = jax.default_backend() == "tpu"
+    page = PAGE if PAGE is not None else (128 if on_tpu else 16)
+    shared_len = max(FLEET_SHARED // page, 1) * page
+    tail = 16
+    full_len = shared_len + tail
+    vocab_cap = 200
+    ladder = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+    top = next((b for b in ladder if b >= full_len), full_len)
+    buckets = tuple(b for b in ladder if b < full_len) + (top,)
+    pages_per_seq = cdiv(full_len + 4, page) + 1
+
+    def engine_config():
+        # max_num_seqs also CAPS the page pool (the engine never holds
+        # more pages than max_num_seqs full sequences): 8 seats keep the
+        # cap above every warmed session's cached chain, so the owner's
+        # cache is not evicting session prefixes before their pull.
+        return EngineConfig(
+            model=get_model_config("debug-tiny"),
+            cache=CacheConfig(
+                page_size=page,
+                num_pages=(2 * (FLEET_SESSIONS + 3) + 4) * pages_per_seq + 1),
+            scheduler=SchedulerConfig(
+                max_num_seqs=8, max_prefill_tokens=top,
+                decode_buckets=(1, 2, 4, 8), prefill_buckets=buckets,
+                decode_window=4, mixed_batch_enabled=False,
+                enable_prefix_caching=True))
+
+    def prompt_of(prefix_seed: int, tail_seed: int) -> list:
+        p_rng = np.random.default_rng(prefix_seed)
+        t_rng = np.random.default_rng(tail_seed)
+        return (p_rng.integers(1, vocab_cap, shared_len).tolist()
+                + t_rng.integers(1, vocab_cap, tail).tolist())
+
+    def scrape(text: str, name: str) -> float:
+        for line in text.splitlines():
+            if line.startswith(name + " "):
+                return float(line.rpartition(" ")[2])
+        return 0.0
+
+    async def run_arm(fleet_on: bool) -> dict:
+        runners = []
+
+        async def serve(**kw):
+            srv = build_server(engine_config(), None, "debug-tiny", **kw)
+            runner = aioweb.AppRunner(srv.build_app())
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runners.append(runner)
+            return f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+        out: dict = {"fleet_cache": fleet_on}
+        try:
+            owner_url = await serve(fleet_prefix_cache=fleet_on)
+            puller_url = await serve(fleet_prefix_cache=fleet_on,
+                                     peer_pool=[owner_url])
+            async with aiohttp.ClientSession() as sess:
+                async def complete(base, prompt, hint=None):
+                    headers = ({PREFIX_SOURCE_HEADER: hint} if hint else {})
+                    t0 = time.perf_counter()
+                    async with sess.post(
+                            f"{base}/v1/completions",
+                            json={"prompt": prompt, "max_tokens": 1,
+                                  "temperature": 0.0},
+                            headers=headers) as resp:
+                        assert resp.status == 200, await resp.text()
+                        await resp.read()
+                    return time.perf_counter() - t0
+
+                # Compile warmup on BOTH replicas: full prefill + the
+                # cached-history tail chunk (discarded local session).
+                for url in (owner_url, puller_url):
+                    await complete(url, prompt_of(90_000, 0))
+                    await complete(url, prompt_of(90_000, 1))
+                # Pull-path warmup (discarded session): the transfer
+                # scatter's first compile must not land in a measured TTFT.
+                await complete(owner_url, prompt_of(91_000, 0))
+                await complete(puller_url, prompt_of(91_000, 1),
+                               hint=owner_url)
+
+                # Warm each session's prefix on the OWNER, then force the
+                # session's next request onto the NON-owner with the hint.
+                warm = []
+                for s in range(FLEET_SESSIONS):
+                    await complete(owner_url, prompt_of(60_000 + s, 0))
+                for s in range(FLEET_SESSIONS):
+                    warm.append(await complete(
+                        puller_url, prompt_of(60_000 + s, 1000 + s),
+                        hint=owner_url))
+                async with sess.get(f"{puller_url}/metrics") as resp:
+                    text = await resp.text()
+                out.update({
+                    "warm_ttft_p50_ms": round(_median(warm) * 1e3, 1),
+                    "pulls_ok": int(scrape(
+                        text,
+                        'kgct_fleet_prefix_pulls_total{outcome="ok"}')),
+                    "pulls_skipped": int(scrape(
+                        text,
+                        'kgct_fleet_prefix_pulls_total{outcome="skipped"}')),
+                    "pulled_bytes": int(scrape(
+                        text, 'kgct_fleet_prefix_bytes_total{dir="pull"}')),
+                    "prefix_cache_hit_ratio": scrape(
+                        text, "kgct_prefix_cache_hit_ratio"),
+                })
+        finally:
+            for runner in reversed(runners):
+                await runner.cleanup()
+        return out
+
+    out: dict = {
+        "sessions": FLEET_SESSIONS,
+        "shared_prefix_tokens": shared_len,
+        "tail_tokens": tail,
+    }
+    for label, fleet_on in (("recompute", False), ("pull", True)):
+        out[label] = asyncio.run(run_arm(fleet_on))
+        gc.collect()
+    pull, rec = out["pull"], out["recompute"]
+    out["fleet_prefix_pull_over_recompute_ttft"] = (
+        round(pull["warm_ttft_p50_ms"] / rec["warm_ttft_p50_ms"], 3)
+        if rec["warm_ttft_p50_ms"] else None)
+    return out
+
+
 def _hist_buckets(text: str, family: str, replicas=None) -> dict:
     """Cumulative bucket counts {le: count} for ``family`` summed over the
     router-relabeled per-replica series (all label sets, e.g. the TTFT
@@ -1894,6 +2062,13 @@ def assemble_output(results: list[dict], backend: str) -> dict:
         # A/B block in configs[-1].router_affinity).
         "router_affinity_warm_over_li_ttft": (
             primary.get("router_affinity", {}).get("warm_ttft_ratio")),
+        # Fleet-cache phase headline: warm TTFT on a NON-owner replica
+        # with the prefix pulled from the ring owner's cache as a
+        # fraction of recomputing it (< 1 = remote KV reuse beats
+        # re-prefill; full A/B block in configs[-1].fleet_cache).
+        "fleet_prefix_pull_over_recompute_ttft": (
+            primary.get("fleet_cache", {})
+            .get("fleet_prefix_pull_over_recompute_ttft")),
         # Disaggregation phase headline: sustained decode TPOT p95 through
         # the role-split prefill/decode topology as a fraction of the
         # colocated topology's, from one router scrape per arm (full A/B
@@ -1979,6 +2154,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
             "over in-process replicas, least-inflight vs prefix-affinity "
             "A/B, default on; 0=skip), KGCT_BENCH_ROUTER_REPLICAS, "
             "KGCT_BENCH_ROUTER_SESSIONS, KGCT_BENCH_ROUTER_ROUNDS, "
+            "KGCT_BENCH_FLEET_CACHE (1=fleet-cache phase: shared-prefix "
+            "sessions forced onto a non-owner replica, prefix PULL from "
+            "the owner's cache vs full recompute A/B on identically-"
+            "seeded replica pairs, default on; 0=skip), "
+            "KGCT_BENCH_FLEET_SESSIONS, KGCT_BENCH_FLEET_SHARED, "
+            "KGCT_FLEET_BW_GBPS, KGCT_FLEET_FLOPS, "
             "KGCT_BENCH_DISAGG (1=disaggregated prefill/decode phase: "
             "role-split 1 prefill + 1 decode replica with KV-page handoff "
             "vs 2 colocated replicas on a mixed long-prefill/long-decode "
@@ -2005,6 +2186,7 @@ _DROPPABLE_HEADLINE = ("ttft_decomposition", "baseline_bar", "mixed_batch",
                        "swap_resume_over_recompute_ttft", "preemptions",
                        "qos_chat_ttft_protected_ratio",
                        "router_affinity_warm_over_li_ttft",
+                       "fleet_prefix_pull_over_recompute_ttft",
                        "disagg_tpot_over_colocated",
                        "drain_migrate_over_wait_seconds",
                        "slo_ttft_attainment_ratio",
@@ -2141,6 +2323,11 @@ def main() -> None:
         # Fleet-routing phase: in-process multi-replica A/B through the
         # real router (always debug-tiny engines; see _measure_router).
         results[-1]["router_affinity"] = _measure_router()
+    if FLEET_BENCH:
+        # Fleet-cache phase: shared-prefix sessions forced onto a
+        # non-owner replica, prefix pull vs full recompute (always
+        # debug-tiny engines; see _measure_fleet_cache).
+        results[-1]["fleet_cache"] = _measure_fleet_cache()
     if DISAGG_BENCH:
         # Disaggregation phase: role-split prefill/decode pools with KV
         # handoff vs colocated replicas (always debug-tiny engines; see

@@ -20,6 +20,7 @@ from .trace_emit import TraceEmitHygieneRule
 from .kv_boundary import KVBoundaryRule
 from .migration_state import MigrationStateSafetyRule
 from .tenant_accounting import TenantAccountingSafetyRule
+from .fleet_fetch import FleetFetchBoundaryRule
 
 ALL_RULES = [
     TraceSafetyRule(),
@@ -37,6 +38,7 @@ ALL_RULES = [
     KVBoundaryRule(),
     MigrationStateSafetyRule(),
     TenantAccountingSafetyRule(),
+    FleetFetchBoundaryRule(),
 ]
 
 

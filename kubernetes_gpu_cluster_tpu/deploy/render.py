@@ -218,6 +218,7 @@ def _engine_args(spec: dict, role: Optional[str] = None,
         args += ["--qos-tiers", qos[0]]
         if qos[1] is not None:
             args += ["--qos-default-tier", qos[1]]
+    peers_emitted = False
     if cfg.get("migrationBudgetSeconds") is not None:
         # Session survivability: live KV migration on drain makes SIGTERM
         # transfer-bound, so the engine's wait-it-out fallback must fit the
@@ -229,6 +230,17 @@ def _engine_args(spec: dict, role: Optional[str] = None,
             # drain may only migrate running streams to sibling pods of the
             # same pool — a client reaching the pod directly cannot point
             # the push at an arbitrary URL (SSRF guard).
+            args += ["--peer-pool", ",".join(peer_urls)]
+            peers_emitted = True
+    if cfg.get("fleetPrefixCache"):
+        # Fleet-wide KV reuse: the N per-pod prefix caches become one
+        # fleet cache — peers pull the ring owner's cached prefix on
+        # affinity overflow and evictions remote-spill to sibling host
+        # tiers. --peer-pool doubles as the pull/spill allowlist (same
+        # SSRF guard as the drain push); topology validation
+        # (per-pod-addressed StatefulSets only) runs in _render_model.
+        args += ["--fleet-prefix-cache"]
+        if peer_urls and not peers_emitted:
             args += ["--peer-pool", ",".join(peer_urls)]
     # enableChunkedPrefill needs no flag: long prompts always chunk here.
     if os.path.isabs(str(spec["modelURL"])):
@@ -475,6 +487,33 @@ def _render_model(spec: dict, engine: dict,
     name = spec["name"]
     cfg = spec.get("vllmConfig") or {}
     disagg = _disagg(spec)
+    if cfg.get("fleetPrefixCache"):
+        # Fleet-wide KV reuse federates the LOCAL prefix cache across
+        # pods that can address each other directly — both preconditions
+        # are render-time-checkable, so a misconfiguration fails the
+        # render with guidance instead of shipping an inert (or
+        # unroutable) fleet cache (same pattern as affinity routing's
+        # StatefulSet requirement).
+        if not cfg.get("enablePrefixCaching"):
+            raise ValueError(
+                f"modelSpec '{name}': fleetPrefixCache requires "
+                "enablePrefixCaching: true — the fleet cache federates "
+                "the per-replica prefix cache; with caching off there is "
+                "nothing to export, import, or spill")
+        if _is_multihost(spec):
+            raise ValueError(
+                f"modelSpec '{name}': fleetPrefixCache does not compose "
+                "with multihost/raySpec — a pipeline group steps in SPMD "
+                "lockstep and cannot import peer KV on rank 0 alone")
+        if disagg is None and not affinity:
+            raise ValueError(
+                f"modelSpec '{name}': fleetPrefixCache needs stable "
+                "per-pod addresses for peer pulls and spills; a plain-"
+                "Service Deployment cannot be addressed pod-by-pod — set "
+                "routingPolicy: prefix-affinity (renders a StatefulSet + "
+                "headless Service per replica, and the router's overflow "
+                "hints are what trigger pulls) or use disaggregated "
+                "prefill/decode pools")
     if disagg is not None:
         return _render_disagg_model(spec, engine, disagg)
     multihost = _is_multihost(spec)

@@ -277,6 +277,11 @@ class LLMEngine:
         # import would starve until some chained sequence finishes.
         self._batch_stale = False
         self._deferred_release: list[Sequence] = []
+        # Streamed fleet-prefix imports in flight (begin_prefix_import):
+        # handle -> {pages, token_ids, filled}. Pages are released on
+        # commit/abort; the serving layer owns abort-on-failure.
+        self._prefix_imports: dict[str, dict] = {}
+        self._prefix_import_seq = 0
         self._last_step_info = None
         self._ttft_transfer_s: Optional[float] = None
         # Width of the host->device output-token resync buffer for the
@@ -1251,6 +1256,235 @@ class LLMEngine:
                               if want_top else None),
             output_top_logprobs=(list(seq.output_top_logprobs)
                                  if want_top else None))]
+
+    # -- fleet-wide prefix cache (global KV reuse over the handoff seam) -----
+
+    def prefix_peek(self, token_ids: list[int]) -> int:
+        """Tokens already covered by the LOCAL prefix cache (either tier) —
+        the pull gate's "what would a local admission reuse anyway" input.
+        Read-only; safe from the worker seam."""
+        return self.scheduler.prefix_peek(token_ids)
+
+    def export_prefix(self, token_ids: list[int],
+                      skip_tokens: int = 0) -> dict:
+        """Serve a peer's fleet-cache fetch: the longest cached prefix of
+        ``token_ids`` — live entries gathered through the ``KVPageIO``
+        seam, host-tier spills READ IN PLACE from the host pool (never
+        restored into the device pool, no LRU touch, no counters: a
+        peer's fetch must not perturb the owner's cache or its locality
+        telemetry) — assembled into one contiguous host buffer.
+
+        ``skip_tokens``: what the puller already holds locally (page-
+        aligned; floored if not). Only pages BEYOND it are exported —
+        the delta the roofline gate actually priced — though the chain
+        walk still runs from token 0 (chained digests commit to the
+        whole prefix). Raises KeyError when prefix caching is off,
+        nothing matches, or the match does not extend past
+        ``skip_tokens`` — the serving layer answers 404 and the peer
+        recomputes locally. Capped at ``len(token_ids) - 1`` like
+        admission reuse, so the importer always keeps >= 1 token to
+        prefill."""
+        pc = self.scheduler.prefix_cache
+        if pc is None:
+            raise KeyError("prefix caching is off on this replica")
+        ps = self.config.cache.page_size
+        skip_pages = max(int(skip_tokens), 0) // ps
+        entries, matched = pc.export_walk(token_ids, len(token_ids) - 1)
+        dev_pages = [p for kind, p in entries if kind == "dev"]
+        try:
+            if matched <= skip_pages * ps:
+                raise KeyError(
+                    "no cached prefix beyond the peer's local coverage"
+                    if matched else "no cached prefix for this prompt")
+            send = entries[skip_pages:]
+            L, _, _, kd = self.kv_cache.k.shape
+            k_np = np.empty((L, len(send), ps, kd), self.kv_cache.k.dtype)
+            v_np = np.empty_like(k_np)
+            dev_ix = [i for i, (kind, _) in enumerate(send)
+                      if kind == "dev"]
+            if dev_ix:
+                # One batched gather for the live slices; the fetch
+                # completes inside export_pages, before the forked
+                # references are released below (KGCT010).
+                dk, dv = self.kv_io.export_pages(
+                    [send[i][1] for i in dev_ix])
+                k_np[:, dev_ix] = dk
+                v_np[:, dev_ix] = dv
+            host_ix = [i for i, (kind, _) in enumerate(send)
+                       if kind == "host"]
+            if host_ix:
+                hk, hv = self.swapper.host.get(
+                    [send[i][1] for i in host_ix])
+                k_np[:, host_ix] = hk
+                v_np[:, host_ix] = hv
+        finally:
+            # Gather completed (or the walk is being abandoned) — either
+            # way the forked device references must not outlive this call.
+            if dev_pages:
+                self.scheduler.allocator.free(dev_pages)
+        return {
+            "model": self.model_config.name,
+            "page_size": ps,
+            "dtype": str(self.kv_cache.k.dtype),
+            "matched_tokens": matched,
+            "start_tokens": skip_pages * ps,
+            "prompt_token_ids": list(token_ids[:matched]),
+            "k": k_np, "v": v_np,
+        }
+
+    def _validate_prefix_header(self, header: dict) -> tuple:
+        """Shared header validation of the streamed prefix import: returns
+        (token_ids, n_pages) or raises ValueError. Everything the
+        post-allocation path consumes converts up front, like
+        import_request — a malformed peer frame must never leak pages."""
+        ps = self.config.cache.page_size
+        if header.get("model") != self.model_config.name:
+            raise ValueError(f"prefix import model {header.get('model')!r} "
+                             f"!= {self.model_config.name!r}")
+        if header.get("page_size") != ps:
+            raise ValueError(f"prefix import page_size "
+                             f"{header.get('page_size')} != {ps}")
+        if str(header.get("dtype")) != str(self.kv_cache.k.dtype):
+            raise ValueError(f"prefix import dtype {header.get('dtype')} "
+                             f"!= {self.kv_cache.k.dtype}")
+        try:
+            ids = [int(t) for t in header["prompt_token_ids"]]
+            matched = int(header["matched_tokens"])
+            start = int(header.get("start_tokens", 0))
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"malformed prefix import header: {e}") from e
+        if matched < ps or matched % ps or len(ids) != matched:
+            raise ValueError(
+                f"prefix import carries {matched} matched tokens over "
+                f"{len(ids)} ids (need a page-aligned, page-covered match)")
+        if start < 0 or start % ps or start >= matched:
+            raise ValueError(
+                f"prefix import start_tokens {start} invalid for "
+                f"{matched} matched tokens")
+        return ids, start // ps, (matched - start) // ps
+
+    def begin_prefix_import(self, header: dict) -> str:
+        """Open a STREAMED prefix import: validate the wire header,
+        allocate the destination pages, and hand back an opaque handle.
+        The serving layer then scatters the pulled pages in bounded chunks
+        (:meth:`import_prefix_chunk`) as they arrive off the socket — each
+        chunk is one worker op, so decode steps for other requests
+        interleave with the transfer instead of stalling behind one blob —
+        and finally registers the chain (:meth:`commit_prefix_import`).
+        This begin/chunk/commit seam is the ONLY sanctioned way remote
+        prefix bytes enter the KV pool (KGCT016)."""
+        pc = self.scheduler.prefix_cache
+        if pc is None:
+            raise ValueError("prefix caching is off on this replica")
+        ids, start_page, need = self._validate_prefix_header(header)
+        alloc = self.scheduler.allocator
+        if not alloc.can_allocate(need):
+            raise RuntimeError(
+                f"no KV pages for prefix import (want {need}, "
+                f"free {alloc.num_free})")
+        self._prefix_import_seq += 1
+        handle = f"pfimp-{self._prefix_import_seq}"
+        self._prefix_imports[handle] = {
+            "pages": alloc.allocate(need), "token_ids": ids,
+            "start_page": start_page, "filled": 0}
+        return handle
+
+    def import_prefix_chunk(self, handle: str, k_np: np.ndarray,
+                            v_np: np.ndarray) -> None:
+        """Scatter one chunk of pulled pages into the next slice of the
+        handle's destination pages (kv_cache.KVPageIO — schedule-time
+        semantics: runs on the worker thread between steps, never racing a
+        dispatched program)."""
+        st = self._prefix_imports.get(handle)
+        if st is None:
+            raise ValueError(f"unknown prefix import handle {handle!r}")
+        ps = self.config.cache.page_size
+        L, _, _, kd = self.kv_cache.k.shape
+        n = k_np.shape[1] if k_np.ndim == 4 else -1
+        if (n < 1 or tuple(k_np.shape) != (L, n, ps, kd)
+                or k_np.shape != v_np.shape
+                or str(k_np.dtype) != str(self.kv_cache.k.dtype)
+                or st["filled"] + n > len(st["pages"])):
+            self.abort_prefix_import(handle)
+            raise ValueError(
+                f"prefix import chunk shape {tuple(k_np.shape)} invalid "
+                f"at offset {st['filled']}/{len(st['pages'])} pages")
+        self.kv_io.import_pages(
+            st["pages"][st["filled"]:st["filled"] + n], k_np, v_np)
+        st["filled"] += n
+
+    def commit_prefix_import(self, handle: str) -> int:
+        """Close a streamed import: every destination page must be filled;
+        the chain registers into the prefix cache (the cache forks its own
+        reference per new digest) and the import's references are released
+        — pages whose digest was registered concurrently by a local
+        prefill simply return to the pool (dedupe). Returns the matched
+        token count now serveable from the local cache."""
+        st = self._prefix_imports.pop(handle, None)
+        if st is None:
+            raise ValueError(f"unknown prefix import handle {handle!r}")
+        pc = self.scheduler.prefix_cache
+        if st["filled"] != len(st["pages"]):
+            self.scheduler.allocator.free(st["pages"])
+            raise ValueError(
+                f"prefix import truncated: {st['filled']}/"
+                f"{len(st['pages'])} pages arrived")
+        pc.register(st["token_ids"], st["pages"],
+                    start_page=st["start_page"])
+        self.scheduler.allocator.free(st["pages"])
+        return len(st["token_ids"])
+
+    def abort_prefix_import(self, handle: str) -> None:
+        """Release a streamed import that will not complete (peer died,
+        bound exceeded, chunk mismatch). Idempotent."""
+        st = self._prefix_imports.pop(handle, None)
+        if st is not None:
+            self.scheduler.allocator.free(st["pages"])
+
+    def accept_remote_spill(self, digest_hex: str, k_np: np.ndarray,
+                            v_np: np.ndarray) -> bool:
+        """Receive one remote-spilled prefix page into the local HOST tier
+        (kv_cache.PrefixCache.accept_host_entry): host memory only — a
+        peer's cold prefix never takes device pages until a local lookup
+        actually second-chances it. False when the host tier is off/full
+        or the frame does not match this pool's geometry."""
+        pc = self.scheduler.prefix_cache
+        if pc is None:
+            return False
+        ps = self.config.cache.page_size
+        L, _, _, kd = self.kv_cache.k.shape
+        if (tuple(k_np.shape) != (L, 1, ps, kd)
+                or k_np.shape != v_np.shape
+                or str(k_np.dtype) != str(self.kv_cache.k.dtype)):
+            return False
+        try:
+            digest = bytes.fromhex(digest_hex)
+        except ValueError:
+            return False
+        return pc.accept_host_entry(digest, k_np, v_np)
+
+    def enable_fleet_spill(self, sink) -> bool:
+        """Arm the remote-spill eviction rung: ``sink(digest_hex, k_np,
+        v_np) -> bool`` receives each evicted page the local host tier
+        could not take (called on the worker thread mid-eviction, so it
+        must only enqueue — the serving layer's bounded spill queue pushes
+        to peers asynchronously). The gather runs through the KVPageIO
+        seam and completes before the eviction frees the page (KGCT010).
+        False when prefix caching is off."""
+        pc = self.scheduler.prefix_cache
+        if pc is None:
+            return False
+
+        def hook(digest: bytes, page: int) -> bool:
+            try:
+                k_np, v_np = self.kv_io.export_pages([page])
+                return bool(sink(digest.hex(), k_np, v_np))
+            except Exception:
+                logger.exception("fleet spill hook failed; dropping page")
+                return False
+
+        pc.fleet_spill = hook
+        return True
 
     def step(self) -> list[RequestOutput]:
         # Chaos site: KGCT_FAULT=step_stall:delay=N sleeps here, simulating a

@@ -487,6 +487,16 @@ class PrefixCache:
     Host entries are a flat LRU keyed by digest: an entry whose parent left
     the host tier becomes unreachable, drifts to the LRU head untouched, and
     is reclaimed under the next pressure — bounded, no subtree bookkeeping.
+
+    Fleet tier (``fleet_spill`` hooked by the serving layer when
+    ``--fleet-prefix-cache`` is on): when the HOST rung cannot take an
+    evicted page (swap off, host pool full, transfer failure), the page is
+    offered to a PEER replica's host tier before being dropped — the
+    remote-spill rung of the eviction ladder. The hook gathers the page
+    content itself (fetch completes inside the call, before the free —
+    KGCT010) and must never raise; a peer-received page enters through
+    :meth:`accept_host_entry`, keyed by the same chained digest, so the
+    peer's own ``lookup`` second-chances it like any local spill.
     """
 
     def __init__(self, allocator: "PageAllocator"):
@@ -503,6 +513,10 @@ class PrefixCache:
         self.swapper: Optional["KVSwapper"] = None
         self._host_entries: "OrderedDict[bytes, int]" = OrderedDict()
         self.host_hits = 0
+        # Fleet remote-spill rung: callable(digest, page) -> bool, set via
+        # LLMEngine.enable_fleet_spill when fleet caching is on. Called
+        # ONLY when the local host rung could not take the page.
+        self.fleet_spill = None
 
     def attach_swapper(self, swapper: "KVSwapper") -> None:
         self.swapper = swapper
@@ -534,10 +548,17 @@ class PrefixCache:
             yield digest
 
     def lookup(self, token_ids: list[int],
-               max_tokens: Optional[int] = None) -> tuple[list[int], int]:
+               max_tokens: Optional[int] = None,
+               record_stats: bool = True) -> tuple[list[int], int]:
         """Longest page-aligned cached prefix of ``token_ids`` (capped at
         ``max_tokens``). Returns (forked page ids, matched token count) —
-        caller owns one reference per returned page."""
+        caller owns one reference per returned page.
+
+        ``record_stats=False`` keeps the hit/miss counters untouched: the
+        fleet-cache EXPORT path serves a peer's fetch through the same walk,
+        and counting those as local hits would poison the per-replica
+        locality gauges (``kgct_router_replica_prefix_cache_hit_ratio``)
+        the affinity router reads."""
         ps = self.allocator.page_size
         n = len(token_ids) // ps
         if max_tokens is not None:
@@ -559,11 +580,59 @@ class PrefixCache:
             pages.append(page)
             matched += ps
             parent = digest
-        if matched:
-            self.hits += 1
-        else:
-            self.misses += 1
+        if record_stats:
+            if matched:
+                self.hits += 1
+            else:
+                self.misses += 1
         return pages, matched
+
+    def export_walk(self, token_ids: list[int], max_tokens: int
+                    ) -> tuple[list, int]:
+        """Chain walk for a PEER's fetch: returns (entries, matched) where
+        each entry is ``("dev", page)`` — forked, the caller owns one
+        reference and must free after its gather — or ``("host", hp)`` —
+        the host-tier page id, to be READ IN PLACE from the host pool.
+        Unlike ``lookup`` this never restores a spilled page into the
+        device pool, never touches LRU order, and never bumps any counter:
+        serving a peer must not mutate the owner's cache state or skew its
+        locality telemetry."""
+        ps = self.allocator.page_size
+        n = min(len(token_ids) // ps, max_tokens // ps)
+        entries: list = []
+        matched = 0
+        for digest in self._page_digests(token_ids, n, ps):
+            page = self._entries.get(digest)
+            if page is not None:
+                self.allocator.fork(page)
+                entries.append(("dev", page))
+            else:
+                hp = self._host_entries.get(digest)
+                if hp is None:
+                    break
+                entries.append(("host", hp))
+            matched += ps
+        return entries, matched
+
+    def peek(self, token_ids: list[int],
+             max_tokens: Optional[int] = None) -> int:
+        """Token count of the longest cached prefix of ``token_ids`` —
+        counting live entries AND host-spilled second-chance entries —
+        WITHOUT forking pages, restoring spills, touching LRU order, or
+        recording stats. The fleet-cache pull gate reads it: what is
+        already local (either tier) costs at most a memcpy and must never
+        be pulled from a peer."""
+        ps = self.allocator.page_size
+        n = len(token_ids) // ps
+        if max_tokens is not None:
+            n = min(n, max_tokens // ps)
+        matched = 0
+        for digest in self._page_digests(token_ids, n, ps):
+            if digest not in self._entries and \
+                    digest not in self._host_entries:
+                break
+            matched += ps
+        return matched
 
     def _second_chance(self, digest: bytes, parent: bytes) -> Optional[int]:
         """Host-tier hit: restore the spilled page into a fresh device page
@@ -586,17 +655,24 @@ class PrefixCache:
         self.host_hits += 1
         return page
 
-    def register(self, token_ids: list[int], pages: list[int]) -> None:
+    def register(self, token_ids: list[int], pages: list[int],
+                 start_page: int = 0) -> None:
         """Register the full pages backing ``token_ids`` (a completed prompt
         prefill). First registration of a digest wins; already-cached pages
-        are left alone (dedupe)."""
+        are left alone (dedupe).
+
+        ``start_page``: the pages cover the chain FROM that page index
+        (a fleet-cache delta import ships only the tail the importer did
+        not already hold); the digest chain still walks from token 0 —
+        chained digests commit to the whole prefix by construction."""
         ps = self.allocator.page_size
-        n = min(len(pages), len(token_ids) // ps)
+        n = min(start_page + len(pages), len(token_ids) // ps)
         parent = b""
         for i, digest in enumerate(self._page_digests(token_ids, n, ps)):
-            if digest not in self._entries:
-                self.allocator.fork(pages[i])       # the cache's reference
-                self._entries[digest] = pages[i]
+            if i >= start_page and digest not in self._entries:
+                page = pages[i - start_page]
+                self.allocator.fork(page)           # the cache's reference
+                self._entries[digest] = page
                 if parent:
                     self._children.setdefault(parent, set()).add(digest)
             parent = digest
@@ -620,6 +696,7 @@ class PrefixCache:
             page = self._entries.pop(d, None)
             if page is None:
                 continue
+            spilled = False
             if self.swapper is not None and d not in self._host_entries:
                 # Spill BEFORE the free: the gather must read the page while
                 # the cache's reference still pins it (KGCT010). Best-effort
@@ -627,10 +704,43 @@ class PrefixCache:
                 hp = self.swapper.spill_page(page)
                 if hp is not None:
                     self._host_entries[d] = hp
+                    spilled = True
+            elif d in self._host_entries:
+                spilled = True
+            if not spilled and self.fleet_spill is not None:
+                # Remote-spill rung: the host tier could not take the page
+                # (swap off / host full / transfer failure) — offer it to a
+                # peer's host tier before dropping. The hook gathers the
+                # content itself and the gather completes inside the call,
+                # before the free below (KGCT010); it never raises (the
+                # serving layer bounds and best-efforts the push).
+                self.fleet_spill(d, page)
             self.allocator.free([page])
             dropped += 1
             stack.extend(self._children.pop(d, ()))
         return dropped
+
+    def accept_host_entry(self, digest: bytes, k_np: np.ndarray,
+                          v_np: np.ndarray) -> bool:
+        """Receive a PEER's remote-spilled page into the local host tier,
+        keyed by its chained digest — the receiving half of the fleet
+        eviction rung. The page becomes an ordinary ``_host_entries`` spill:
+        a later ``lookup`` whose chain reaches the digest second-chances it
+        back into the device pool exactly like a local spill. False (and no
+        state change) when the host tier is off, full, or already holds the
+        digest — remote spill never evicts local entries (local sessions
+        and local spills outrank a peer's cold prefixes)."""
+        if self.swapper is None:
+            return False
+        if digest in self._host_entries or digest in self._entries:
+            return False
+        host = self.swapper.host
+        if not host.can_allocate(1):
+            return False
+        [hp] = host.allocate(1)
+        host.put([hp], k_np, v_np)
+        self._host_entries[digest] = hp
+        return True
 
 
 class CachingPageAllocator(PageAllocator):

@@ -874,6 +874,18 @@ class Scheduler:
             logger.info("%s: prefix cache hit, %d/%d tokens reused",
                         seq.request_id, matched, seq.num_tokens)
 
+    def prefix_peek(self, token_ids: list[int]) -> int:
+        """Tokens of ``token_ids`` already covered by the local prefix
+        cache (device OR host tier), capped like admission's reuse at
+        ``len(token_ids) - 1`` so the count means "tokens a local admission
+        would NOT recompute". 0 when prefix caching is off. Read-only —
+        the fleet-cache pull gate calls this from the worker seam to price
+        a remote pull against what is already here."""
+        if self.prefix_cache is None or len(token_ids) < 2:
+            return 0
+        return self.prefix_cache.peek(token_ids,
+                                      max_tokens=len(token_ids) - 1)
+
     def _register_prefix(self, seq: Sequence) -> None:
         """Content-address this sequence's full PROMPT pages so later
         requests sharing the prefix reuse them. Called at prompt-prefill

@@ -206,6 +206,22 @@ class Observability:
         self.swap_latency = Histogram(
             "kgct_kv_swap_seconds", "host<->device KV page transfer latency",
             labels=("dir",))
+        # Fleet-wide prefix cache (serving/fleet_cache.py): remote prefix
+        # pulls by outcome — "ok" (imported into the local cache),
+        # "recompute" (pull failed/timed out/peer missed: local prefill
+        # serves it, byte-identical), "skipped" (the roofline gate priced
+        # the pull above recompute, or the prefix was already local) — and
+        # remote spills by outcome — "ok" (a peer parked the evicted
+        # page), "dropped" (bounded queue displaced it / peer had no
+        # room), "error" (push failed). Pre-seeded so a fresh scrape
+        # renders zeros for every outcome, nan-free, fleet cache off
+        # included.
+        self.fleet_pulls = {"ok": 0, "recompute": 0, "skipped": 0}
+        self.fleet_spills = {"ok": 0, "dropped": 0, "error": 0}
+        self.fleet_bytes = {"pull": 0, "spill": 0}
+        self.fleet_pull_latency = Histogram(
+            "kgct_fleet_prefix_pull_seconds",
+            "remote prefix pull wall latency (fetch + streamed import)")
 
     # -- multi-tenant QoS ----------------------------------------------------
 
@@ -269,6 +285,25 @@ class Observability:
             self.swap_pages[direction] += pages
         self.swap_latency.observe(duration_s, (direction,))
         self.tracer.emit("swap", request_id, dir=direction, pages=pages)
+
+    def on_fleet_pull(self, outcome: str, n_bytes: int = 0,
+                      duration_s=None) -> None:
+        """One fleet-cache pull decision/attempt (bounded outcome set —
+        unknown spellings fold into "recompute" so label cardinality can
+        never grow)."""
+        if outcome not in self.fleet_pulls:
+            outcome = "recompute"
+        self.fleet_pulls[outcome] += 1
+        self.fleet_bytes["pull"] += n_bytes
+        if duration_s is not None:
+            self.fleet_pull_latency.observe(duration_s)
+
+    def on_fleet_spill(self, outcome: str, n_bytes: int = 0) -> None:
+        """One remote-spill attempt (sender side)."""
+        if outcome not in self.fleet_spills:
+            outcome = "error"
+        self.fleet_spills[outcome] += 1
+        self.fleet_bytes["spill"] += n_bytes
 
     def on_first_token(self, seq, fetch_s: float = 0.0) -> None:
         ttft = seq.first_token_time - seq.arrival_time
@@ -501,6 +536,21 @@ class Observability:
         lines.append("# TYPE kgct_kv_swap_in_pages_total counter")
         lines.append("kgct_kv_swap_in_pages_total %d" % self.swap_pages["in"])
         lines.extend(self.swap_latency.render())
+        # Fleet-wide prefix cache: every outcome pre-seeded — zeros when
+        # the fleet cache is off or idle, never an absent series.
+        lines.append("# TYPE kgct_fleet_prefix_pulls_total counter")
+        for oc in sorted(self.fleet_pulls):
+            lines.append(f'kgct_fleet_prefix_pulls_total{{outcome="{oc}"}} '
+                         f"{self.fleet_pulls[oc]}")
+        lines.append("# TYPE kgct_fleet_prefix_spills_total counter")
+        for oc in sorted(self.fleet_spills):
+            lines.append(f'kgct_fleet_prefix_spills_total{{outcome="{oc}"}} '
+                         f"{self.fleet_spills[oc]}")
+        lines.append("# TYPE kgct_fleet_prefix_bytes_total counter")
+        for d in sorted(self.fleet_bytes):
+            lines.append(f'kgct_fleet_prefix_bytes_total{{dir="{d}"}} '
+                         f"{self.fleet_bytes[d]}")
+        lines.extend(self.fleet_pull_latency.render())
         return lines
 
     def export_perfetto(self) -> dict:

@@ -66,12 +66,17 @@ from ..utils import get_logger
 from .async_engine import AsyncLLMEngine
 from ..engine.qos import resolve_tier_name, tenant_key_of
 from .errors import (MIGRATE_URL_HEADER, PREFILL_URL_HEADER,
-                     QOS_TIER_HEADER, REQUEST_ID_HEADER, RESUME_MODE_HEADER,
+                     PREFIX_SOURCE_HEADER, QOS_TIER_HEADER,
+                     REQUEST_ID_HEADER, RESUME_MODE_HEADER,
                      StreamMigratedError, valid_request_id)
 from .errors import overloaded_error as _overloaded
+from .fleet_cache import SpillQueue, build_pull_policy
 from .handoff import (HANDOFF_TIMEOUT_S, MIGRATE_PUSH_TIMEOUT_S,
-                      MigrationStore, decode_handoff, encode_handoff,
-                      fetch_handoff, handoff_request_body, push_handoff)
+                      PREFIX_PULL_TIMEOUT_S, MigrationStore,
+                      PrefixStreamDecoder, decode_handoff, decode_spill_frame,
+                      encode_handoff, encode_prefix_frames,
+                      encode_spill_frame, fetch_handoff, handoff_request_body,
+                      push_handoff)
 from .metrics import Metrics
 from .tokenizer import (IncrementalDetokenizer, Tokenizer,
                         apply_chat_template, load_tokenizer)
@@ -230,7 +235,8 @@ class APIServer:
                  resilience: Optional[ResilienceConfig] = None,
                  role: str = "both",
                  prefill_pool: Optional[list] = None,
-                 peer_pool: Optional[list] = None):
+                 peer_pool: Optional[list] = None,
+                 fleet_prefix_cache: bool = False):
         if role not in REPLICA_ROLES:
             raise ValueError(f"unknown replica role {role!r} "
                              f"(known: {', '.join(REPLICA_ROLES)})")
@@ -265,6 +271,11 @@ class APIServer:
         # the network boundary (dev/tests).
         self.peer_pool = (frozenset(u.rstrip("/") for u in peer_pool)
                           if peer_pool else None)
+        # Ordered sibling list for the fleet-cache remote-spill push (the
+        # allowlist above is the same set; order gives the round-robin
+        # target rotation a stable spelling).
+        self.peer_list = (tuple(u.rstrip("/") for u in peer_pool)
+                          if peer_pool else ())
         # KV handoff does not compose with multihost SPMD lockstep: an
         # import/hold on rank 0 alone would desynchronize the followers'
         # schedulers, so a mesh leader forces plain colocated serving.
@@ -286,6 +297,37 @@ class APIServer:
                              if prefill_pool else None)
         self._http: Optional[Any] = None   # lazy aiohttp.ClientSession
         self._profile_busy = False
+        # Fleet-wide prefix cache (--fleet-prefix-cache): this replica
+        # serves peers' prefix fetches (/internal/fetch_prefix), pulls the
+        # ring owner's cached prefix when the router's pick overflowed
+        # (PREFIX_SOURCE_HEADER), and remote-spills evicted prefix pages
+        # to siblings' host tiers. Requires the local prefix cache (the
+        # thing being federated) and no multihost leader (same SPMD
+        # constraint as the handoff seam). Off = byte-identical serving.
+        pc = engine.engine.scheduler.prefix_cache
+        self.fleet_on = bool(fleet_prefix_cache and self._handoff_ok
+                             and pc is not None)
+        if fleet_prefix_cache and not self.fleet_on:
+            logger.warning(
+                "fleet prefix cache disabled: %s",
+                "prefix caching is off (--enable-prefix-caching)"
+                if pc is None else "multihost leader (SPMD lockstep)")
+        self._pull_policy = None
+        self._spill_queue: Optional[SpillQueue] = None
+        self._spill_task = None
+        if self.fleet_on:
+            import jax
+            eng = engine.engine
+            self._pull_policy = build_pull_policy(
+                eng.model_config, eng.config.cache.page_size,
+                eng.kv_cache.k.dtype.itemsize, jax.default_backend())
+            logger.info("fleet prefix cache on: pull policy %s",
+                        self._pull_policy.describe())
+            if self.peer_list:
+                # Remote-spill rung: the eviction hook (worker thread)
+                # only enqueues; the async drain task pushes to peers.
+                self._spill_queue = SpillQueue()
+                eng.enable_fleet_spill(self._offer_spill)
         res = resilience or ResilienceConfig()
         self.res_config = res
         self.drain_state = DrainState()
@@ -349,6 +391,8 @@ class APIServer:
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/internal/kv_handoff", self.kv_handoff)
         app.router.add_post("/internal/resume", self.resume)
+        app.router.add_post("/internal/fetch_prefix", self.fetch_prefix)
+        app.router.add_post("/internal/fleet_spill", self.fleet_spill)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.prometheus)
@@ -388,8 +432,13 @@ class APIServer:
         import asyncio
         self.engine.start(asyncio.get_running_loop())
         self.watchdog.start()
+        if self._spill_queue is not None:
+            self._spill_task = asyncio.get_running_loop().create_task(
+                self._drain_spills())
 
     async def _on_cleanup(self, app: web.Application) -> None:
+        if self._spill_task is not None:
+            self._spill_task.cancel()
         if self._http is not None:
             await self._http.close()
         self.engine.shutdown()
@@ -1096,6 +1145,230 @@ class APIServer:
                         bytes=len(data), ms=round(dt * 1e3, 2))
         return state
 
+    # -- fleet-wide prefix cache (global KV reuse) ---------------------------
+
+    def _offer_spill(self, digest_hex: str, k_np, v_np) -> bool:
+        """Eviction-hook sink (WORKER thread): enqueue one remote-spill
+        candidate; never blocks, never raises. A displaced (oldest)
+        entry is a counted drop."""
+        if not self._spill_queue.offer(digest_hex, k_np, v_np):
+            self.engine.engine.obs.on_fleet_spill("dropped")
+        return True
+
+    async def _drain_spills(self) -> None:
+        """Async remote-spill pusher: rotate evicted pages across the
+        sibling pool (--peer-pool) until one parks each page in its host
+        tier. A peer with no room answers 507 and the rotation walks on;
+        no peer taking it is a counted drop — the page was re-computable,
+        this rung is pure opportunism."""
+        import asyncio
+
+        import aiohttp
+        eng = self.engine.engine
+        idx = 0
+        while True:
+            item = self._spill_queue.pop()
+            if item is None:
+                await asyncio.sleep(0.2)
+                continue
+            digest_hex, k_np, v_np = item
+            frame = encode_spill_frame(
+                digest_hex, k_np, v_np, eng.model_config.name,
+                eng.config.cache.page_size)
+            if self._http is None:
+                self._http = aiohttp.ClientSession()
+            outcome = "dropped"
+            for _ in range(len(self.peer_list)):
+                url = self.peer_list[idx % len(self.peer_list)]
+                idx += 1
+                try:
+                    async with self._http.post(
+                            f"{url}/internal/fleet_spill", data=frame,
+                            headers={"Content-Type":
+                                     "application/octet-stream"},
+                            timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                        if resp.status == 200:
+                            outcome = "ok"
+                            await resp.read()
+                            break
+                        await resp.read()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    outcome = "error"
+            eng.obs.on_fleet_spill(outcome,
+                                   len(frame) if outcome == "ok" else 0)
+            eng.obs.tracer.emit("fleet_prefix", "", side="spill",
+                                outcome=outcome, digest=digest_hex[:16])
+
+    async def fetch_prefix(self, request: web.Request) -> web.StreamResponse:
+        """Fleet-cache EXPORT half: serve the longest locally cached
+        prefix of the posted prompt (live entries + host-tier second
+        chances) as a streamed prefix frame (serving/handoff.py codec).
+        404 when nothing matches or the fleet cache is off — the peer
+        recomputes locally, byte-identical."""
+        if not self.fleet_on:
+            return _error(404, "fleet prefix cache is not enabled on this "
+                               "replica")
+        if self.drain_state.is_draining:
+            return _overloaded(503, "server is draining; fetch elsewhere", 1)
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        ids = body.get("prompt_token_ids")
+        if (not isinstance(ids, list) or len(ids) < 2
+                or not all(isinstance(t, int) and not isinstance(t, bool)
+                           for t in ids)):
+            return _error(400, "prompt_token_ids must be a list of >= 2 "
+                               "token ids")
+        try:
+            # What the puller already holds: only the DELTA beyond it is
+            # exported (the span its roofline gate actually priced).
+            have = max(int(body.get("have_tokens", 0)), 0)
+        except (TypeError, ValueError):
+            return _error(400, "have_tokens must be an integer")
+        rid = request.get("kgct_request_id") or self.engine.next_request_id(
+            "pfx")
+        obs = self.engine.engine.obs
+        t0 = time.perf_counter()
+        try:
+            state = await self.engine.run_in_worker(
+                lambda e: e.export_prefix(ids, skip_tokens=have))
+        except KeyError as e:
+            return _error(404, str(e))
+        resp = web.StreamResponse(headers={
+            "Content-Type": "application/octet-stream",
+            REQUEST_ID_HEADER: rid})
+        await resp.prepare(request)
+        n_bytes = 0
+        for part in encode_prefix_frames(state):
+            await resp.write(bytes(part))
+            n_bytes += len(part)
+        await resp.write_eof()
+        obs.tracer.emit(
+            "fleet_prefix", rid, side="export",
+            tokens=state["matched_tokens"], bytes=n_bytes,
+            ms=round((time.perf_counter() - t0) * 1e3, 2))
+        return resp
+
+    async def fleet_spill(self, request: web.Request) -> web.Response:
+        """Fleet-cache remote-spill RECEIVE half: park one peer-evicted
+        prefix page in the local HOST tier, keyed by its chained digest
+        (host memory only — device pages are spent only if a local lookup
+        later second-chances it). 507 when the host tier is off/full so
+        the pusher's rotation walks on."""
+        if not self.fleet_on:
+            return _error(404, "fleet prefix cache is not enabled on this "
+                               "replica")
+        data = await request.read()
+        try:
+            digest_hex, header, k_np, v_np = decode_spill_frame(data)
+        except ValueError as e:
+            return _error(400, f"bad spill frame: {e}")
+        if header.get("model") != self.engine.engine.model_config.name:
+            return _error(409, f"spill model {header.get('model')!r} != "
+                               f"{self.engine.engine.model_config.name!r}")
+        ok = await self.engine.run_in_worker(
+            lambda e: e.accept_remote_spill(digest_hex, k_np, v_np))
+        if not ok:
+            return _error(507, "no host-tier room for the spilled page")
+        self.engine.engine.obs.tracer.emit(
+            "fleet_prefix", "", side="recv", digest=digest_hex[:16],
+            bytes=len(data))
+        return web.json_response({"parked": True})
+
+    async def _pull_prefix(self, source_url: str, rid: str,
+                           ids: list[int]) -> None:
+        """Fleet-cache IMPORT half: on the router's PREFIX_SOURCE_HEADER
+        hint, pull the ring owner's cached prefix and STREAM it into the
+        local prefix cache (begin/chunk/commit worker ops — each chunk
+        scatter interleaves with other requests' decode steps instead of
+        blocking on the full blob). Gated by the anti-thrash roofline
+        policy: what is already local, sub-page, or priced above a local
+        recompute is skipped. ANY failure — including the deterministic
+        chaos site ``kv_pull_fail`` — degrades to local recompute
+        (outcome="recompute"), byte-identical, with the trigger in the
+        trace ring and the flight recorder."""
+        import aiohttp
+        obs = self.engine.engine.obs
+        t0 = time.perf_counter()
+        handle = None
+        try:
+            if _inject_fault("kv_pull_fail"):
+                raise RuntimeError(
+                    "KGCT_FAULT kv_pull_fail: injected prefix pull failure")
+            local = await self.engine.run_in_worker(
+                lambda e: e.prefix_peek(ids))
+            remaining = (len(ids) - 1) - local
+            if remaining < self._pull_policy.min_tokens:
+                obs.on_fleet_pull("skipped")
+                obs.tracer.emit("fleet_prefix", rid, side="import",
+                                outcome="skipped", reason="local_warm",
+                                local_tokens=local)
+                return
+            if not self._pull_policy.pull_beats_recompute(remaining):
+                # The roofline prices the transfer above a local
+                # re-prefill: never fetch what is cheaper to recompute.
+                obs.on_fleet_pull("skipped")
+                obs.tracer.emit("fleet_prefix", rid, side="import",
+                                outcome="skipped", reason="roofline",
+                                tokens=remaining)
+                return
+            if self._http is None:
+                self._http = aiohttp.ClientSession()
+            dec = PrefixStreamDecoder()
+            n_bytes = 0
+            async with self._http.post(
+                    f"{source_url.rstrip('/')}/internal/fetch_prefix",
+                    json={"prompt_token_ids": list(ids),
+                          "have_tokens": local},
+                    headers={REQUEST_ID_HEADER: rid},
+                    timeout=aiohttp.ClientTimeout(
+                        total=PREFIX_PULL_TIMEOUT_S)) as resp:
+                if resp.status != 200:
+                    snippet = (await resp.content.read(2048)).decode(
+                        "utf-8", errors="replace")
+                    raise RuntimeError(
+                        f"prefix fetch {resp.status}: {snippet[:200]}")
+                async for chunk in resp.content.iter_chunked(1 << 16):
+                    n_bytes += len(chunk)
+                    if n_bytes > self._handoff_max_bytes:
+                        raise RuntimeError(
+                            f"prefix stream exceeds the local bound "
+                            f"{self._handoff_max_bytes}")
+                    parts = dec.feed(chunk)
+                    if handle is None and dec.header is not None:
+                        hdr = dict(dec.header)
+                        handle = await self.engine.run_in_worker(
+                            lambda e: e.begin_prefix_import(hdr))
+                    for ck, cv in parts:
+                        await self.engine.run_in_worker(
+                            lambda e, h=handle, k=ck, v=cv:
+                            e.import_prefix_chunk(h, k, v))
+            if handle is None or not dec.done:
+                raise RuntimeError("prefix stream truncated")
+            tokens = await self.engine.run_in_worker(
+                lambda e, h=handle: e.commit_prefix_import(h))
+            handle = None
+            dt = time.perf_counter() - t0
+            obs.on_fleet_pull("ok", n_bytes, dt)
+            obs.tracer.emit("fleet_prefix", rid, side="import",
+                            outcome="ok", tokens=tokens, bytes=n_bytes,
+                            ms=round(dt * 1e3, 2))
+        except Exception as e:
+            dt = time.perf_counter() - t0
+            if handle is not None:
+                self.engine.post_to_worker(
+                    lambda e2, h=handle: e2.abort_prefix_import(h))
+            logger.warning("fleet prefix pull from %s failed (%s); local "
+                           "recompute serves it", source_url, e,
+                           extra={"request_id": rid})
+            obs.on_fleet_pull("recompute", 0, dt)
+            obs.tracer.emit("fleet_prefix", rid, side="import",
+                            outcome="recompute", error=str(e)[:200],
+                            ms=round(dt * 1e3, 2))
+
     async def completions(self, request: web.Request) -> web.StreamResponse:
         try:
             body = await request.json()
@@ -1249,6 +1522,38 @@ class APIServer:
                     # see the degradation instead of a green post-pull
                     # arrival stamp.
                     pull_t0 = t0
+        # Fleet-wide prefix cache: on affinity overflow/remap the router
+        # names the ring owner whose cache holds this prompt's prefix
+        # (PREFIX_SOURCE_HEADER, router-owned — client values stripped at
+        # the proxy). Pull it into the LOCAL prefix cache before admission
+        # so the prefill below reuses the pages instead of recomputing
+        # them. Skipped when a full-sequence handoff already carries the
+        # KV; the --peer-pool allowlist guards direct-to-pod traffic the
+        # router's strip cannot cover (same SSRF story as the prefill
+        # url).
+        psrc = request.headers.get(PREFIX_SOURCE_HEADER)
+        if (self.fleet_on and handoff is None and psrc
+                and self.role != "prefill"
+                and psrc.startswith(("http://", "https://"))):
+            if (self.peer_pool is not None
+                    and psrc.rstrip("/") not in self.peer_pool):
+                logger.warning("prefix source %s not in --peer-pool; "
+                               "serving by local prefill", psrc,
+                               extra={"request_id": rid})
+                self.engine.engine.obs.on_fleet_pull("recompute")
+                self.engine.engine.obs.tracer.emit(
+                    "fleet_prefix", rid, side="import", outcome="recompute",
+                    error="prefix source not in --peer-pool")
+            else:
+                # The pull's wall time — success OR failure, up to the
+                # pull timeout — is client-observed TTFT: backdate the
+                # admission stamp so the histogram/SLO window see it
+                # (the earlier disagg-pull stamp, when one exists,
+                # already covers this span).
+                t0p = time.monotonic()
+                await self._pull_prefix(psrc, rid, ids)
+                if pull_t0 is None:
+                    pull_t0 = t0p
         self.metrics.on_request()
 
         rid = self._reserve_rid(request, rid)
@@ -1602,14 +1907,16 @@ def build_server(config: EngineConfig, tokenizer_path: Optional[str] = None,
                  model_name: Optional[str] = None, params=None,
                  mesh=None, leader=None, role: str = "both",
                  prefill_pool: Optional[list] = None,
-                 peer_pool: Optional[list] = None) -> APIServer:
+                 peer_pool: Optional[list] = None,
+                 fleet_prefix_cache: bool = False) -> APIServer:
     tokenizer = load_tokenizer(tokenizer_path)
     engine = AsyncLLMEngine(config, params=params,
                             eos_token_id=tokenizer.eos_token_id, mesh=mesh,
                             leader=leader)
     return APIServer(engine, tokenizer, model_name or config.model.name,
                      resilience=config.resilience, role=role,
-                     prefill_pool=prefill_pool, peer_pool=peer_pool)
+                     prefill_pool=prefill_pool, peer_pool=peer_pool,
+                     fleet_prefix_cache=fleet_prefix_cache)
 
 
 def main(argv: Optional[list[str]] = None) -> None:
@@ -1722,6 +2029,17 @@ def main(argv: Optional[list[str]] = None) -> None:
                    "stream local, wait-it-out style (SSRF guard, mirror of "
                    "--prefill-pool). Unset = any url (single-tenant "
                    "network)")
+    p.add_argument("--fleet-prefix-cache", action="store_true",
+                   help="fleet-wide KV reuse (global prefix cache): serve "
+                   "peers' prefix fetches on /internal/fetch_prefix, pull "
+                   "the ring owner's cached prefix on the router's "
+                   "x-kgct-prefix-source hint instead of recomputing it "
+                   "(anti-thrash roofline gate: never fetch what is "
+                   "cheaper to re-prefill; KGCT_FLEET_BW_GBPS / "
+                   "KGCT_FLEET_FLOPS override the priced constants), and "
+                   "remote-spill evicted prefix pages to --peer-pool "
+                   "siblings' host tiers before dropping them. Requires "
+                   "--enable-prefix-caching; off = byte-identical serving")
     p.add_argument("--drain-grace-s", type=float, default=None,
                    help="SIGTERM drain: max seconds to wait for in-flight "
                    "requests before exiting anyway (default 120). With "
@@ -1877,7 +2195,8 @@ def main(argv: Optional[list[str]] = None) -> None:
                           peer_pool=([u.strip() for u in
                                       args.peer_pool.split(",")
                                       if u.strip()]
-                                     if args.peer_pool else None))
+                                     if args.peer_pool else None),
+                          fleet_prefix_cache=args.fleet_prefix_cache)
     app = server.build_app()
 
     async def _arm_sigterm(app_):

@@ -40,6 +40,16 @@ PREFILL_URL_HEADER = "x-kgct-prefill-url"
 # proxy; ``--peer-pool`` is the direct-to-pod allowlist).
 MIGRATE_URL_HEADER = "x-kgct-migrate-url"
 
+# Fleet-wide prefix cache: the router names the ring OWNER of this
+# request's affinity key when the pick had to land elsewhere (owner
+# over-bound or out of rotation) — the chosen replica pulls the owner's
+# cached prefix KV instead of recomputing it (``POST
+# /internal/fetch_prefix``, serving/fleet_cache.py). Router-set like the
+# prefill url (client values stripped at the proxy); ``--peer-pool`` is
+# the direct-to-pod allowlist, and the replica-side roofline gate skips
+# pulls priced above a local recompute.
+PREFIX_SOURCE_HEADER = "x-kgct-prefix-source"
+
 # Multi-tenant QoS: the request's priority class. Resolution order (one
 # definition, engine/qos.resolve_tier_name, shared by router and replica):
 # a valid inbound header naming a CONFIGURED tier wins; else the

@@ -140,6 +140,186 @@ def handoff_request_body(prompt_token_ids: list, body: dict) -> dict:
     return fwd
 
 
+# -- fleet-prefix stream codec (global prefix cache over this substrate) ----
+#
+# The sequence-handoff frame above is one blob: header, all K bytes, all V
+# bytes — fine for a one-sequence import that joins ``running`` atomically.
+# A FLEET-CACHE prefix pull wants the opposite: the importer scatters pages
+# as they arrive off the socket (each chunk one worker op, interleaving
+# with other requests' decode steps), so the wire layout interleaves K and
+# V per page-chunk instead of splitting them at the frame's midpoint.
+# Frame: PREFIX_MAGIC + u32 header length + JSON header (model/page_size/
+# dtype/matched_tokens/prompt_token_ids/k_shape/chunk_pages) + one
+# [k_chunk][v_chunk] slab per chunk of ``chunk_pages`` pages (the last
+# chunk may be short). No pickle, same discipline as the handoff frame.
+
+PREFIX_MAGIC = b"KGCT-PF1"
+
+# Pages per streamed chunk: small enough that a chunk scatter never blocks
+# the worker loop noticeably, large enough that per-chunk op overhead stays
+# negligible next to the copy.
+PREFIX_CHUNK_PAGES = 4
+
+# Wall bound for one prefix pull. Much tighter than the sequence-handoff
+# pull: no prefill compute hides inside it (the pages are already cached on
+# the owner) — it is connect + gather + transfer, and a replica that gives
+# up just recomputes locally.
+PREFIX_PULL_TIMEOUT_S = 30.0
+
+
+def encode_prefix_frames(state: dict,
+                         chunk_pages: int = PREFIX_CHUNK_PAGES):
+    """Engine export dict (``LLMEngine.export_prefix``) -> an iterator of
+    wire slabs: the header first, then one contiguous ``[k|v]`` slab per
+    page chunk. The exporter writes each slab straight to the response so
+    the importer can start scattering before the tail pages even left the
+    owner's socket."""
+    k, v = state["k"], state["v"]
+    header = {key: val for key, val in state.items()
+              if key not in ("k", "v")}
+    header["k_shape"] = list(k.shape)
+    header["chunk_pages"] = int(chunk_pages)
+    hb = json.dumps(header).encode()
+    yield PREFIX_MAGIC + struct.pack(">I", len(hb)) + hb
+    n = k.shape[1]
+    for i in range(0, n, chunk_pages):
+        ck, cv = k[:, i:i + chunk_pages], v[:, i:i + chunk_pages]
+        slab = bytearray(ck.nbytes + cv.nbytes)
+        view = memoryview(slab)
+        np.copyto(np.frombuffer(view, ck.dtype,
+                                count=ck.size).reshape(ck.shape),
+                  np.ascontiguousarray(ck))
+        np.copyto(np.frombuffer(view, cv.dtype, count=cv.size,
+                                offset=ck.nbytes).reshape(cv.shape),
+                  np.ascontiguousarray(cv))
+        yield slab
+
+
+class PrefixStreamDecoder:
+    """Incremental decoder of the prefix stream: feed socket chunks in,
+    get (k_chunk, v_chunk) page slabs out as soon as each completes.
+    ``header`` is available once the first feed crossed the header
+    boundary; ``done`` once every advertised page was yielded. Raises
+    ValueError on any structural mismatch (bad magic, oversized header,
+    trailing bytes) — the importer aborts and recomputes."""
+
+    def __init__(self):
+        # bytearray: += is amortized O(1). An immutable bytes buffer
+        # would memcpy the whole accumulated slab on EVERY socket chunk —
+        # quadratic in slab size, ruinous at real-model page geometry.
+        self._buf = bytearray()
+        self.header: Optional[dict] = None
+        self._shape = None          # (L, n_pages, ps, kd)
+        self._dtype = None
+        self._chunk_pages = 0
+        self._yielded_pages = 0
+
+    @property
+    def done(self) -> bool:
+        return (self._shape is not None
+                and self._yielded_pages >= self._shape[1])
+
+    def _try_header(self) -> None:
+        m = len(PREFIX_MAGIC)
+        if len(self._buf) < m + 4:
+            return
+        if self._buf[:m] != PREFIX_MAGIC:
+            raise ValueError("prefix stream: bad magic")
+        (hlen,) = struct.unpack(">I", self._buf[m:m + 4])
+        if hlen > HEADER_MAX_BYTES:
+            raise ValueError(
+                f"prefix stream: header {hlen} bytes exceeds bound")
+        if len(self._buf) < m + 4 + hlen:
+            return
+        try:
+            header = json.loads(bytes(self._buf[m + 4:m + 4 + hlen]))
+        except ValueError as e:
+            raise ValueError(
+                f"prefix stream: bad header JSON ({e})") from None
+        # Missing/garbage fields must surface as ValueError — the one
+        # exception class every caller's degrade-to-recompute (and the
+        # spill handler's 400) catches; a KeyError here would escape as
+        # an unhandled 500.
+        try:
+            shape = tuple(int(d) for d in header.pop("k_shape"))
+            self._chunk_pages = int(header.pop("chunk_pages", 0))
+            dtype = _np_dtype(str(header["dtype"]))
+        except ValueError:
+            raise
+        except Exception as e:
+            raise ValueError(
+                f"prefix stream: malformed header ({e!r})") from None
+        if len(shape) != 4 or any(d < 1 for d in shape):
+            raise ValueError(f"prefix stream: bad k_shape {shape}")
+        if self._chunk_pages < 1:
+            raise ValueError("prefix stream: bad chunk_pages")
+        self._shape = shape
+        self._dtype = dtype
+        self.header = header
+        del self._buf[:m + 4 + hlen]
+
+    def feed(self, data: bytes) -> list:
+        """Returns the list of (k_chunk, v_chunk) arrays completed by this
+        feed, each of shape ``[L, c, ps, kd]``. Copies out of the buffer so
+        the arrays stay valid after further feeds."""
+        self._buf += data
+        if self.header is None:
+            self._try_header()
+            if self.header is None:
+                return []
+        out = []
+        L, n, ps, kd = self._shape
+        per_page = L * ps * kd * self._dtype.itemsize
+        while self._yielded_pages < n:
+            c = min(self._chunk_pages, n - self._yielded_pages)
+            slab = 2 * c * per_page
+            if len(self._buf) < slab:
+                break
+            view = bytes(self._buf[:slab])
+            ck = np.frombuffer(view, self._dtype,
+                               count=c * per_page // self._dtype.itemsize
+                               ).reshape(L, c, ps, kd)
+            cv = np.frombuffer(view, self._dtype,
+                               count=c * per_page // self._dtype.itemsize,
+                               offset=c * per_page
+                               ).reshape(L, c, ps, kd)
+            out.append((ck, cv))
+            del self._buf[:slab]
+            self._yielded_pages += c
+        if self.done and self._buf:
+            raise ValueError(
+                f"prefix stream: {len(self._buf)} trailing bytes")
+        return out
+
+
+def encode_spill_frame(digest_hex: str, k_np: np.ndarray,
+                       v_np: np.ndarray, model: str, page_size: int
+                       ) -> bytes:
+    """One remote-spilled page -> one prefix-stream frame (single chunk)
+    whose header carries the chained digest instead of token ids — the
+    receiver parks it in its HOST tier keyed by the digest
+    (``LLMEngine.accept_remote_spill``)."""
+    state = {"model": model, "page_size": page_size,
+             "dtype": str(k_np.dtype), "digest": digest_hex,
+             "k": k_np, "v": v_np}
+    return b"".join(bytes(part) for part in
+                    encode_prefix_frames(state, chunk_pages=1))
+
+
+def decode_spill_frame(data: bytes) -> tuple[str, dict, np.ndarray,
+                                             np.ndarray]:
+    """Inverse of :func:`encode_spill_frame`: (digest_hex, header, k, v).
+    Raises ValueError on any mismatch."""
+    dec = PrefixStreamDecoder()
+    chunks = dec.feed(data)
+    if dec.header is None or not dec.done or len(chunks) != 1:
+        raise ValueError("spill frame: truncated or multi-chunk")
+    digest = dec.header.get("digest")
+    if not isinstance(digest, str):
+        raise ValueError("spill frame: missing digest")
+    return digest, dec.header, chunks[0][0], chunks[0][1]
+
+
 # Wall bound for one mid-stream migration PUSH (connect + transfer). Much
 # tighter than the pull bound: the blob is already in host memory — no
 # prefill compute hides inside it — and every second here extends the

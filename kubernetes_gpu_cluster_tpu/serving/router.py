@@ -104,7 +104,8 @@ from ..utils import get_logger
 # The engine's shed/drain responses use the same envelope (serving.errors):
 # a router-level 503 is handled by the identical client code path.
 from .errors import (MIGRATE_URL_HEADER, PREFILL_URL_HEADER,
-                     QOS_TIER_HEADER, REQUEST_ID_HEADER, RESUME_MODE_HEADER,
+                     PREFIX_SOURCE_HEADER, QOS_TIER_HEADER,
+                     REQUEST_ID_HEADER, RESUME_MODE_HEADER,
                      valid_request_id)
 from .errors import overloaded_error as _proxy_error
 
@@ -252,6 +253,12 @@ class Replica:
         self.url = url.rstrip("/")
         self.healthy = True
         self.inflight = 0
+        # Per-tier in-flight as the replica itself reports it on /health
+        # (the QoS admission ledger), refreshed by every successful probe.
+        # Router-attributed inflight above misses direct-to-pod and
+        # other-router traffic; this is the replica's own ground truth,
+        # and the tier-aware pick tie-break reads it.
+        self.tier_inflight: dict = {}
         self.consecutive_failures = 0
         # Traffic-failure bench expiry: a replica broken by proxy failures
         # (connect/stall) may still answer /health 200 — its wedge detector
@@ -379,6 +386,13 @@ class Router:
         self.qos_default_tier = qos_default_tier
         self.tier_inflight: dict[str, int] = {
             t.name: 0 for t in self.qos_tiers}
+        # Tier-aware interactive picks (ROADMAP 3c): priorities for the
+        # batch-saturation tie-break in _pick — a higher-priority (more
+        # interactive) request prefers, among equally-loaded candidates,
+        # the replica whose /health ledger shows the LEAST lower-priority
+        # in-flight work (its seats are cheapest to reclaim: the engine's
+        # priority preemption evicts batch work, never peers).
+        self._tier_priority = {t.name: t.priority for t in self.qos_tiers}
         self._resolve_tier_name = self._tenant_key_of = None
         if self.qos_tiers:
             from ..config.qos import resolve_tier_name, tenant_key_of
@@ -454,6 +468,20 @@ class Router:
                     f"{replica.url}/health",
                     timeout=aiohttp.ClientTimeout(total=5)) as resp:
                 ok = resp.status == 200
+                if ok and self.qos_tiers:
+                    # Scrape the replica's own per-tier in-flight ledger
+                    # off the SAME probe (no extra request): the
+                    # tier-aware pick tie-break reads it. Best-effort — a
+                    # replica without the field (older build / QoS off)
+                    # just keeps an empty dict.
+                    try:
+                        body = await resp.json()
+                        tiers = body.get("qos_tiers")
+                        replica.tier_inflight = (
+                            {str(k): int(v) for k, v in tiers.items()}
+                            if isinstance(tiers, dict) else {})
+                    except Exception:
+                        pass
         except Exception:
             ok = False
         # Chaos site replica_down: force the probe of replica index
@@ -713,10 +741,21 @@ class Router:
               include_unhealthy: bool = False,
               affinity_key: Optional[bytes] = None,
               pool: Optional[list] = None,
-              ring: Optional[HashRing] = None) -> Optional[Replica]:
+              ring: Optional[HashRing] = None,
+              pick_tier: Optional[str] = None) -> Optional[Replica]:
         """The ONE replica-selection seam (every proxy attempt, including
         retry-with-exclude, desperation rounds, and the prefill-pool pick
         of disaggregated serving, calls here — KGCT011).
+
+        ``pick_tier`` (the request's RESOLVED QoS tier) engages the
+        tier-aware tie-break on the least-inflight fallback: for a pick of
+        a non-lowest tier, candidates tied on total inflight are further
+        narrowed to those whose /health-scraped ledger shows the least
+        strictly-lower-priority in-flight work — a batch-saturated replica
+        is deprioritized for interactive picks while equally-loaded
+        interactive-only replicas keep the legacy rotation. Tier None (QoS
+        off, or a lowest-tier pick) is byte-identical to the legacy
+        tie-break.
 
         ``affinity_key`` engages the prefix-affinity policy: walk the ring
         from the key's owner, skipping out-of-rotation replicas, and take
@@ -780,10 +819,32 @@ class Router:
             # Every candidate over-bound: saturation, not a routing failure.
         least = min(r.inflight for r in healthy)
         tied = [r for r in healthy if r.inflight == least]
+        if pick_tier is not None and len(tied) > 1:
+            tied = self._tier_tie_break(tied, pick_tier)
         seq = self._pick_seq
         self._pick_seq += 1
         self._pick_info["pick"] = "least_inflight"
         return tied[seq % len(tied)]
+
+    def _tier_tie_break(self, tied: list, pick_tier: str) -> list:
+        """Among total-inflight-tied candidates, keep those with the least
+        strictly-lower-priority in-flight work (the replicas' own /health
+        ledgers). Only engages for non-lowest-tier picks — a batch pick
+        has no lower tier to avoid, and must keep the legacy rotation."""
+        prio = self._tier_priority.get(pick_tier)
+        if prio is None:
+            return tied
+        lower = [name for name, p in self._tier_priority.items()
+                 if p < prio]
+        if not lower:
+            return tied
+        load = {r.url: sum(int(r.tier_inflight.get(name, 0))
+                           for name in lower) for r in tied}
+        floor = min(load.values())
+        kept = [r for r in tied if load[r.url] == floor]
+        if len(kept) < len(tied):
+            self._pick_info["tier_deprioritized"] = len(tied) - len(kept)
+        return kept
 
     def _affinity_key(self, body: bytes, force: bool = False) -> Optional[bytes]:
         """Derive the routing key from an already-buffered request body —
@@ -950,7 +1011,8 @@ class Router:
         try:
             if pr is None:
                 return await self._forward(request, body, rid, akey, None,
-                                           obj=obj, qos_hdr=qos_hdr)
+                                           obj=obj, qos_hdr=qos_hdr,
+                                           tier=tier)
             # The handoff pull slot is outstanding on this prefill replica
             # for the request's lifetime — without the count the prefill
             # pool's bounded-load overflow could never trigger (every
@@ -963,7 +1025,8 @@ class Router:
             pr.inflight += 1
             try:
                 return await self._forward(request, body, rid, akey, pr.url,
-                                           obj=obj, qos_hdr=qos_hdr)
+                                           obj=obj, qos_hdr=qos_hdr,
+                                           tier=tier)
             finally:
                 pr.inflight -= 1
         finally:
@@ -986,6 +1049,28 @@ class Router:
             return None, None
         return tier, tier
 
+    def _prefix_source(self, pick_info: dict,
+                       chosen_url: str) -> Optional[str]:
+        """The PREFIX_SOURCE_HEADER value for a pick that missed its
+        affinity owner, or None. Only a LIVE owner is worth naming: an
+        over-bound owner (overflow) is healthy by construction; a
+        remapped owner may merely be excluded by this request's retry
+        walk — but one that is down or benched would cost the chosen
+        replica a doomed connect before its pull degrades, worse than
+        just recomputing."""
+        if pick_info.get("pick") not in ("affinity_overflow",
+                                         "affinity_remap"):
+            return None
+        owner_url = pick_info.get("owner")
+        if not owner_url or owner_url == chosen_url:
+            return None
+        for r in self.replicas:
+            if r.url == owner_url:
+                if r.healthy and time.monotonic() >= r.benched_until:
+                    return owner_url
+                return None
+        return None
+
     def _ring_successor(self, key: bytes, exclude: set) -> Optional[str]:
         """First healthy main-pool replica on the ring walk from ``key``
         that is not in ``exclude`` — the deterministic migrate-push /
@@ -1005,7 +1090,8 @@ class Router:
                        akey: Optional[bytes],
                        prefill_hdr: Optional[str],
                        obj: Optional[dict] = None,
-                       qos_hdr: Optional[str] = None) -> web.StreamResponse:
+                       qos_hdr: Optional[str] = None,
+                       tier: Optional[str] = None) -> web.StreamResponse:
         """The failover forwarding loop of :meth:`proxy`, split out so the
         prefill-slot accounting brackets it in one try/finally whatever
         path it returns through. ``obj`` (the parsed body) enables
@@ -1035,7 +1121,7 @@ class Router:
             # desperation probe of benched replicas is safe.
             replica = self._pick(exclude=tried,
                                  include_unhealthy=rounds > 0,
-                                 affinity_key=akey)
+                                 affinity_key=akey, pick_tier=tier)
             # Consume the pick classification SYNCHRONOUSLY (no await may
             # sit between the _pick call and this copy): _pick overwrites
             # the shared attribute on its next call, and in an async server
@@ -1064,7 +1150,7 @@ class Router:
                         raise ConnectionRefusedError(
                             "KGCT_FAULT router_connect")
                     stripped = {REQUEST_ID_HEADER, PREFILL_URL_HEADER,
-                                MIGRATE_URL_HEADER}
+                                MIGRATE_URL_HEADER, PREFIX_SOURCE_HEADER}
                     if qos_hdr is not None:
                         # Propagate the ROUTER-resolved tier: both layers
                         # then attribute this request identically (an
@@ -1084,6 +1170,18 @@ class Router:
                         # Router-owned (client values stripped above): the
                         # decode replica pulls prefilled KV from here.
                         fwd_headers[PREFILL_URL_HEADER] = prefill_hdr
+                    psrc = self._prefix_source(pick_info, replica.url)
+                    if psrc is not None:
+                        # Fleet-wide prefix cache: the pick could not land
+                        # on the affinity owner (over-bound or out of this
+                        # round's rotation) — name the owner so the chosen
+                        # replica can PULL its cached prefix instead of
+                        # recomputing it (/internal/fetch_prefix; the
+                        # replica's roofline gate prices the pull and any
+                        # failure degrades to local recompute). Router-
+                        # owned, like the prefill url: client values are
+                        # stripped above.
+                        fwd_headers[PREFIX_SOURCE_HEADER] = psrc
                     mig_url = None
                     if failover_ok:
                         # Name the drain-push target (ring successor of the

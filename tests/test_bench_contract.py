@@ -338,3 +338,41 @@ class TestDrainPhaseContract:
         for knob in ("KGCT_BENCH_DRAIN", "KGCT_BENCH_DRAIN_SESSIONS",
                      "KGCT_BENCH_DRAIN_MAX_NEW"):
             assert knob in text
+
+
+class TestFleetCachePhaseContract:
+    """KGCT_BENCH_FLEET_CACHE rides the bounded last-line contract like
+    the other phases: headline parseable from the last stdout line,
+    droppable under the byte bound, null when the phase was skipped."""
+
+    def test_headline_parses_in_last_line(self):
+        results = _fake_results()
+        results[-1]["fleet_cache"] = {
+            "sessions": 3, "shared_prefix_tokens": 384, "tail_tokens": 16,
+            "recompute": {"warm_ttft_p50_ms": 30.5, "pulls_ok": 0},
+            "pull": {"warm_ttft_p50_ms": 17.2, "pulls_ok": 4,
+                     "pulled_bytes": 1580314},
+            "fleet_prefix_pull_over_recompute_ttft": 0.564,
+        }
+        out = bench.assemble_output(results, "cpu")
+        parsed = bench.parse_result_line(json.dumps(out) + "\n")
+        assert parsed["fleet_prefix_pull_over_recompute_ttft"] == 0.564
+        assert parsed["configs"][-1]["fleet_cache"]["pull"]["pulls_ok"] == 4
+
+    def test_headline_is_droppable_under_the_bound(self):
+        assert ("fleet_prefix_pull_over_recompute_ttft"
+                in bench._DROPPABLE_HEADLINE)
+        out = bench.assemble_output(_fake_results(), "cpu")
+        line = json.dumps(bench.compact_result(out))
+        assert len(line) <= bench.RESULT_LINE_MAX
+
+    def test_absent_phase_yields_null_headline(self):
+        out = bench.assemble_output(_fake_results(), "cpu")
+        assert out["fleet_prefix_pull_over_recompute_ttft"] is None
+
+    def test_help_lists_fleet_knobs(self):
+        text = bench.build_arg_parser().format_help()
+        for knob in ("KGCT_BENCH_FLEET_CACHE", "KGCT_BENCH_FLEET_SESSIONS",
+                     "KGCT_BENCH_FLEET_SHARED", "KGCT_FLEET_BW_GBPS",
+                     "KGCT_FLEET_FLOPS"):
+            assert knob in text

@@ -818,3 +818,67 @@ def test_autoscaling_rejected_for_static_pod_list_topologies():
             {"name": "m", "modelURL": "tinyllama-1.1b",
              "vllmConfig": {"pipelineParallelSize": 2},
              "autoscaling": {"enabled": True}}]}})
+
+
+def test_fleet_prefix_cache_knob():
+    """vllmConfig.fleetPrefixCache: --fleet-prefix-cache plus the
+    --peer-pool pull/spill allowlist, on per-pod-addressed topologies
+    only — plain-Service Deployments refuse the render with guidance
+    (same pattern as affinity routing), as do multihost groups and specs
+    without the local prefix cache the fleet cache federates."""
+    # Prefix-affinity StatefulSet: flag + sibling allowlist.
+    values = copy.deepcopy(VALUES)
+    cfg = values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"]
+    cfg["fleetPrefixCache"] = True
+    cfg["enablePrefixCaching"] = True
+    cfg["routingPolicy"] = "prefix-affinity"
+    ms = render_values(values)
+    args = ms["qwen3-engine-statefulset.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--fleet-prefix-cache" in args
+    assert args[args.index("--peer-pool") + 1] == ",".join(
+        f"http://kgct-qwen3-engine-{i}.kgct-qwen3-engine-hl:8000"
+        for i in range(2))
+    # With a migration budget too, --peer-pool renders exactly ONCE.
+    values2 = copy.deepcopy(values)
+    values2["servingEngineSpec"]["modelSpec"][0]["vllmConfig"][
+        "migrationBudgetSeconds"] = 20
+    ms = render_values(values2)
+    args = ms["qwen3-engine-statefulset.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args.count("--peer-pool") == 1
+    assert "--fleet-prefix-cache" in args
+    # Disaggregated pools are per-pod-addressed: renders without affinity.
+    ms = render_values(_disagg_values(
+        vllmConfig={"fleetPrefixCache": True, "enablePrefixCaching": True}))
+    args = ms["m-decode-engine-statefulset.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--fleet-prefix-cache" in args
+    assert args[args.index("--peer-pool") + 1] == ",".join(
+        f"http://kgct-m-decode-engine-{i}.kgct-m-decode-engine-hl:8000"
+        for i in range(3))
+    # Plain-Service Deployment: refused with guidance.
+    bad = copy.deepcopy(VALUES)
+    bad["servingEngineSpec"]["modelSpec"][0]["vllmConfig"].update(
+        {"fleetPrefixCache": True, "enablePrefixCaching": True})
+    with pytest.raises(ValueError, match="per-pod"):
+        render_values(bad)
+    # Without the local prefix cache there is nothing to federate.
+    bad = copy.deepcopy(VALUES)
+    bad["servingEngineSpec"]["modelSpec"][0]["vllmConfig"].update(
+        {"fleetPrefixCache": True, "routingPolicy": "prefix-affinity"})
+    with pytest.raises(ValueError, match="enablePrefixCaching"):
+        render_values(bad)
+    # Multihost: SPMD lockstep cannot import peer KV on rank 0 alone.
+    bad = {"servingEngineSpec": {"modelSpec": [
+        {"name": "m", "modelURL": "tinyllama-1.1b",
+         "vllmConfig": {"pipelineParallelSize": 2,
+                        "fleetPrefixCache": True,
+                        "enablePrefixCaching": True}}]}}
+    with pytest.raises(ValueError, match="multihost"):
+        render_values(bad)
+    # Off (absent) keeps manifests byte-stable: no flag anywhere.
+    ms = render_values(copy.deepcopy(VALUES))
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--fleet-prefix-cache" not in args

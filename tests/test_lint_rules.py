@@ -885,3 +885,64 @@ class TestTenantAccountingSafety:  # KGCT015
                 adm.tier_inflight["batch"] += 1
                 return vt
         """, "KGCT015", relpath="serving/metrics.py") == []
+
+
+class TestFleetFetchBoundary:  # KGCT016
+    def test_handler_side_import_fires(self):
+        """A serving handler calling an import seam directly on the event
+        loop — the scatter would race the step loop against the donated
+        pool."""
+        found = lint("""
+            class Handler:
+                async def fetch(self, request):
+                    state = decode(await request.read())
+                    self.engine.engine.import_request("r", [1], None, state)
+        """, "KGCT016", relpath="serving/api_server.py")
+        assert len(found) == 1 and "worker" in found[0].message
+
+    def test_worker_wrapped_import_silent(self):
+        assert lint("""
+            class Handler:
+                async def fetch(self, request):
+                    state = decode(await request.read())
+                    await self.engine.run_in_worker(
+                        lambda e: e.import_request("r", [1], None, state))
+        """, "KGCT016", relpath="serving/api_server.py") == []
+
+    def test_streamed_chunk_scatter_outside_worker_fires(self):
+        found = lint("""
+            async def pull(engine, dec, data):
+                for ck, cv in dec.feed(data):
+                    engine.import_prefix_chunk("h", ck, cv)
+        """, "KGCT016", relpath="serving/api_server.py")
+        assert found and "import_prefix_chunk" in found[0].message
+
+    def test_post_to_worker_cleanup_silent(self):
+        assert lint("""
+            def cleanup(self, handle):
+                self.engine.post_to_worker(
+                    lambda e: e.abort_prefix_import(handle))
+        """, "KGCT016", relpath="serving/api_server.py") == []
+
+    def test_kv_cache_rebind_fires(self):
+        found = lint("""
+            def f(engine, kv):
+                engine.kv_cache = kv
+        """, "KGCT016", relpath="serving/router.py")
+        assert found and "kv_cache" in found[0].message
+
+    def test_engine_modules_out_of_scope(self):
+        """The engine package IS the seam's home; the rule polices only
+        serving-side entry points."""
+        assert lint("""
+            def f(self, state):
+                self.import_request("r", [1], None, state)
+        """, "KGCT016", relpath="engine/engine.py") == []
+
+    def test_async_engine_worker_loop_exempt(self):
+        """The worker loop executes the seam by definition — it is the
+        other side of run_in_worker, not a bypass."""
+        assert lint("""
+            def _worker(self):
+                self.engine.import_request("r", [1], None, {})
+        """, "KGCT016", relpath="serving/async_engine.py") == []

@@ -290,7 +290,9 @@ async def _recording_replica(extra_metrics=""):
 
     async def completions(request):
         rid = request.headers.get(REQUEST_ID_HEADER, "")
-        served.append({"body": await request.json(), "request_id": rid})
+        served.append({"body": await request.json(), "request_id": rid,
+                       "headers": {k.lower(): v
+                                   for k, v in request.headers.items()}})
         if rid:
             tracer.emit("arrival", rid, prompt_tokens=1)
             tracer.emit("first_token", rid, ttft_ms=1.0)
@@ -967,3 +969,181 @@ class TestDisaggRouting:
         assert snap["inflight"][self.PF_URLS[0]] == 3
         assert self.PF_URLS[0] in snap["healthy"]
         assert self.PF_URLS[1] not in snap["healthy"]
+
+
+class TestTierAwarePicks:
+    """ROADMAP 3c: interactive-tier picks deprioritize batch-saturated
+    replicas using the per-tier /health inflight ledger the health probe
+    already scrapes — engine-free, all inside the one _pick seam."""
+
+    def _router(self, n=3):
+        from kubernetes_gpu_cluster_tpu.config.qos import parse_qos_tiers
+        return _router(policy="least-inflight",
+                       urls=[f"http://r{i}:8000" for i in range(n)],
+                       qos_tiers=parse_qos_tiers("default"))
+
+    def test_interactive_pick_avoids_batch_saturated_replica(self):
+        router = self._router()
+        router.replicas[0].tier_inflight = {"interactive": 0, "batch": 5}
+        # Total inflight ties at 0 everywhere: the interactive pick must
+        # rotate over the two batch-free replicas only.
+        urls = {router._pick(pick_tier="interactive").url
+                for _ in range(6)}
+        assert urls == {"http://r1:8000", "http://r2:8000"}
+        assert router._pick_info.get("tier_deprioritized") == 1
+
+    def test_batch_pick_keeps_legacy_rotation(self):
+        """A lowest-tier pick has no lower tier to avoid: the legacy
+        round-robin covers every replica, batch-saturated included."""
+        router = self._router()
+        router.replicas[0].tier_inflight = {"batch": 5}
+        urls = {router._pick(pick_tier="batch").url for _ in range(3)}
+        assert urls == {r.url for r in router.replicas}
+
+    def test_tier_none_byte_identical_rotation(self):
+        """QoS-off picks (tier None) ignore the ledger entirely — the
+        pre-existing least-inflight behavior, ledger or not."""
+        router = self._router()
+        router.replicas[0].tier_inflight = {"batch": 99}
+        urls = {router._pick().url for _ in range(3)}
+        assert urls == {r.url for r in router.replicas}
+
+    def test_total_inflight_stays_primary(self):
+        """The tie-break is SECONDARY: a genuinely less-loaded replica
+        wins even when its ledger shows batch work (that work is
+        engine-preemptible for the interactive request; an extra live
+        stream is not)."""
+        router = self._router()
+        router.replicas[0].tier_inflight = {"batch": 9}
+        router.replicas[1].inflight = 1
+        router.replicas[2].inflight = 1
+        assert router._pick(pick_tier="interactive").url == "http://r0:8000"
+
+    def test_health_probe_scrapes_the_ledger(self):
+        """The /health probe body's qos_tiers dict lands on the Replica —
+        no extra request, best-effort on replicas without the field."""
+        import aiohttp
+
+        async def run():
+            from kubernetes_gpu_cluster_tpu.config.qos import parse_qos_tiers
+            from aiohttp import web as aioweb
+
+            async def health(request):
+                return aioweb.json_response(
+                    {"status": "ok", "qos_tiers": {"batch": 7,
+                                                   "interactive": 1}})
+            app = aioweb.Application()
+            app.router.add_get("/health", health)
+            runner = aioweb.AppRunner(app)
+            await runner.setup()
+            site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            url = f"http://127.0.0.1:{runner.addresses[0][1]}"
+            router = Router([url], health_interval_s=9999,
+                            qos_tiers=parse_qos_tiers("default"))
+            router._session = aiohttp.ClientSession()
+            try:
+                await router._check(router.replicas[0], startup=True)
+                assert router.replicas[0].tier_inflight == {
+                    "batch": 7, "interactive": 1}
+            finally:
+                await router._session.close()
+                await runner.cleanup()
+        asyncio.run(run())
+
+
+class TestPrefixSourceHint:
+    """Fleet-wide prefix cache, router half: an overflow/remap pick names
+    the ring owner in x-kgct-prefix-source so the chosen replica can pull
+    the owner's cached prefix — engine-free pins of the hint derivation
+    plus one proxied header-forwarding check."""
+
+    def _owner_and_other(self, router, key):
+        owner_url = router.ring.owner(key)
+        owner = next(r for r in router.replicas if r.url == owner_url)
+        other = next(r for r in router.replicas if r.url != owner_url)
+        return owner, other
+
+    def test_overflow_pick_names_the_owner(self):
+        router = _router(urls=URLS[:2], balance_factor=1.0)
+        key = b"hot-prefix"
+        owner, other = self._owner_and_other(router, key)
+        owner.inflight = 5                      # over the CHWBL bound
+        picked = router._pick(affinity_key=key)
+        assert picked.url == other.url
+        assert router._pick_info["pick"] == "affinity_overflow"
+        assert router._prefix_source(dict(router._pick_info),
+                                     picked.url) == owner.url
+
+    def test_affinity_hit_carries_no_hint(self):
+        router = _router(urls=URLS[:2])
+        key = b"cold-prefix"
+        picked = router._pick(affinity_key=key)
+        assert router._pick_info["pick"] == "affinity_hit"
+        assert router._prefix_source(dict(router._pick_info),
+                                     picked.url) is None
+
+    def test_downed_owner_is_not_named(self):
+        """A remap whose owner is DOWN must not be named: the pull would
+        burn a doomed connect before degrading — worse than recomputing."""
+        router = _router(urls=URLS[:2])
+        key = b"hot-prefix-2"
+        owner, other = self._owner_and_other(router, key)
+        owner.healthy = False
+        picked = router._pick(affinity_key=key)
+        assert picked.url == other.url
+        assert router._pick_info["pick"] == "affinity_remap"
+        assert router._prefix_source(dict(router._pick_info),
+                                     picked.url) is None
+
+    def test_excluded_healthy_owner_is_named(self):
+        """A remap because the owner was EXCLUDED (this request's retry
+        walk) still names it: the owner is alive and its cache is warm."""
+        router = _router(urls=URLS[:2])
+        key = b"hot-prefix-3"
+        owner, other = self._owner_and_other(router, key)
+        picked = router._pick(affinity_key=key, exclude={owner.url})
+        assert picked.url == other.url
+        assert router._pick_info["pick"] == "affinity_remap"
+        assert router._prefix_source(dict(router._pick_info),
+                                     picked.url) == owner.url
+
+    def test_overflowed_pick_forwards_the_hint_upstream(self):
+        """Through the real proxy: the over-bound owner's url rides
+        x-kgct-prefix-source to the chosen replica, and a client-supplied
+        value is stripped (router-owned header)."""
+        async def scenario():
+            a_runner, a_url, a_served = await _recording_replica()
+            b_runner, b_url, b_served = await _recording_replica()
+            router = Router([a_url, b_url], health_interval_s=9999,
+                            routing_policy="prefix-affinity",
+                            balance_factor=1.0)
+            client = await _start_router(router)
+            try:
+                from kubernetes_gpu_cluster_tpu.serving.errors import \
+                    PREFIX_SOURCE_HEADER
+                body = {"prompt": "shared prefix body", "stream": False}
+                r = await client.post(
+                    "/v1/completions", json=body,
+                    headers={PREFIX_SOURCE_HEADER: "http://evil:1"})
+                assert r.status == 200
+                served = (a_served + b_served)[-1]
+                # Affinity hit: no hint, and the client's value is gone.
+                assert PREFIX_SOURCE_HEADER not in served["headers"]
+                # Saturate the owner so the next pick overflows.
+                owner_url = router.ring.owner(
+                    router._affinity_key_from_obj(body))
+                owner = next(rep for rep in router.replicas
+                             if rep.url == owner_url)
+                other_served = b_served if owner_url == a_url else a_served
+                owner.inflight = 5
+                r = await client.post("/v1/completions", json=body)
+                assert r.status == 200
+                served = other_served[-1]      # the overflow target
+                assert served["headers"].get(
+                    PREFIX_SOURCE_HEADER) == owner_url
+            finally:
+                await client.close()
+                await a_runner.cleanup()
+                await b_runner.cleanup()
+        asyncio.run(scenario())
