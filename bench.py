@@ -96,14 +96,24 @@ OVERLOAD_TTFT_BUDGET_MS = float(
 # KGCT_BENCH_MIXED=0 runs the legacy prefill-else-decode policy (A/B).
 MIXED_BATCH = os.environ.get("KGCT_BENCH_MIXED", "1") != "0"
 # Speculative decoding phase (engine/spec/): greedy decode over a
-# repetitive-suffix workload (the n-gram proposer's home turf), spec-on vs
-# spec-off on identically-seeded engines, reporting acceptance ratio and
-# accepted tokens per spec step. KGCT_BENCH_SPEC=0 skips the phase;
-# KGCT_BENCH_SPEC_K sets the draft length.
+# repetitive-suffix workload (the n-gram proposer's home turf), a three-way
+# A/B on identically-seeded engines — off / n-gram / draft-MODEL (with
+# acceptance-adaptive k) — reporting acceptance ratio, accepted tokens per
+# spec step, and the draft-over-ngram speedup headline; plus a spec×mixed
+# arm measuring chat TTFT with speculation AND mixed batching on against
+# mixed-only (spec must no longer forfeit the stall-free TTFT win).
+# KGCT_BENCH_SPEC=0 skips the phase; KGCT_BENCH_SPEC_K sets the draft
+# length; KGCT_BENCH_SPEC_DRAFT names the draft preset (default: the
+# target preset itself — same arch and seed, the oracle-draft harness
+# ceiling; real small-draft checkpoints are a TPU-round story);
+# KGCT_BENCH_SPEC_MIXED=0 skips the composition arm.
 SPEC_BENCH = os.environ.get("KGCT_BENCH_SPEC", "1") != "0"
 SPEC_K = int(os.environ.get("KGCT_BENCH_SPEC_K", 4))
 SPEC_BATCH = int(os.environ.get("KGCT_BENCH_SPEC_BATCH", 4))
 SPEC_MAX_NEW = int(os.environ.get("KGCT_BENCH_SPEC_MAX_NEW", 96))
+SPEC_DRAFT = os.environ.get("KGCT_BENCH_SPEC_DRAFT", "")
+SPEC_MIXED_BENCH = os.environ.get("KGCT_BENCH_SPEC_MIXED", "1") != "0"
+SPEC_CHAT_PROBES = int(os.environ.get("KGCT_BENCH_SPEC_CHAT_PROBES", 6))
 # Prefix-reuse phase (engine/kv_cache.PrefixCache): a shared-system-prompt
 # workload — cold requests with unique prompts vs warm requests sharing a
 # page-aligned prefix — showing warm-prefix TTFT collapsing toward the
@@ -635,21 +645,36 @@ def _measure_overload(engine, rng, vocab, rate_rps, budget_ms):
 def _measure_spec(model_name: str, quant, rng) -> dict:
     """Speculative-decoding phase: greedy decode over a repetitive-suffix
     workload (prompts built from a short repeated pattern, so prompt-lookup
-    drafts hit), spec-on vs spec-off engines with IDENTICAL weights (same
-    config seed). Reports the acceptance ratio, accepted draft tokens per
-    spec step (the >1.0 bar that makes a verify step beat a plain decode
-    step in tokens), and the decode tokens/sec of both engines. Runs after
-    the main config's engine is freed — on-chip, two more model
-    instantiations must not overlap the big serving pool."""
+    drafts hit), a three-way A/B on engines with IDENTICAL weights (same
+    config seed): off ("base"), n-gram ("spec"), and draft-MODEL with
+    acceptance-adaptive k ("draft"). Reports per arm the acceptance ratio,
+    accepted draft tokens per spec step (the >1.0 bar that makes a verify
+    step beat a plain decode step in tokens), and decode tokens/sec; the
+    draft arm adds the adaptive controller's live k and movement counts.
+
+    Draft-model caveat (CPU): the default draft is the TARGET preset at
+    the SAME seed — an oracle draft (acceptance ~1.0) that validates the
+    two-model machinery and the adaptive ceiling, but whose per-token
+    draft cost equals the target's, so `spec_draft_over_ngram_speedup`
+    measures harness overhead, not the production win. The production
+    ratio needs a genuinely small draft (KGCT_BENCH_SPEC_DRAFT, e.g.
+    tinyllama-1.1b drafting for llama-3-8b) and real checkpoints — the
+    BENCH_r06 TPU round (ROADMAP item 1(b)). Runs after the main config's
+    engine is freed — on-chip, extra model instantiations must not
+    overlap the big serving pool."""
     on_tpu = jax.default_backend() == "tpu"
     page = PAGE if PAGE is not None else (128 if on_tpu else 16)
     pattern = rng.integers(1, 200, 12).tolist()
     reps = cdiv(PROMPT_LEN, len(pattern))
     prompts = [(pattern * reps)[:PROMPT_LEN] for _ in range(SPEC_BATCH)]
     params = SamplingParams(max_tokens=SPEC_MAX_NEW, temperature=0.0)
-    out = {"k": SPEC_K, "batch": SPEC_BATCH, "max_new": SPEC_MAX_NEW}
+    draft_name = SPEC_DRAFT or model_name
+    out = {"k": SPEC_K, "batch": SPEC_BATCH, "max_new": SPEC_MAX_NEW,
+           "draft_model": draft_name}
 
-    for label, spec in (("base", False), ("spec", True)):
+    arms = (("base", False, None), ("spec", True, None),
+            ("draft", True, draft_name))
+    for label, spec, draft in arms:
         pages_per_seq = cdiv(PROMPT_LEN + SPEC_MAX_NEW + SPEC_K, page) + 2
         cfg = EngineConfig(
             model=get_model_config(model_name).replace(quantization=quant),
@@ -659,7 +684,8 @@ def _measure_spec(model_name: str, quant, rng) -> dict:
                 max_num_seqs=SPEC_BATCH, max_prefill_tokens=PREFILL_BUDGET,
                 decode_buckets=(SPEC_BATCH,), prefill_buckets=(PREFILL_BUDGET,),
                 decode_window=DECODE_WINDOW, mixed_batch_enabled=False,
-                spec_decode_enabled=spec, num_speculative_tokens=SPEC_K))
+                spec_decode_enabled=spec, num_speculative_tokens=SPEC_K,
+                spec_draft_model=draft, spec_adaptive_k=draft is not None))
         engine = LLMEngine(cfg, eos_token_id=None)
         # Warmup pass compiles every program this workload touches (the
         # measurement discipline: never time XLA compilation).
@@ -689,7 +715,7 @@ def _measure_spec(model_name: str, quant, rng) -> dict:
             drafted = engine.obs.spec_drafted_tokens - drafted0
             accepted = engine.obs.spec_accepted_tokens - accepted0
             n_spec = engine.obs.step_kind_counts["spec"] - spec_steps0
-            out["spec"].update({
+            out[label].update({
                 "spec_steps": n_spec,
                 "drafted_tokens": drafted,
                 "accepted_tokens": accepted,
@@ -698,12 +724,152 @@ def _measure_spec(model_name: str, quant, rng) -> dict:
                 "accepted_tokens_per_spec_step": (round(accepted / n_spec, 2)
                                                   if n_spec else None),
             })
+        ctrl = engine.scheduler.spec_controller
+        if ctrl is not None:
+            out[label]["adaptive_k"] = {
+                "current_k": ctrl.current_k, "ladder": list(ctrl.ladder),
+                "steps_down": ctrl.num_steps_down,
+                "steps_up": ctrl.num_steps_up,
+            }
         del engine
         gc.collect()
-    base, spec = out["base"], out["spec"]
+    base, spec, draft = out["base"], out["spec"], out["draft"]
     out["speedup"] = (round(spec["decode_tokens_per_sec"]
                             / base["decode_tokens_per_sec"], 3)
                       if base["decode_tokens_per_sec"] else None)
+    out["spec_draft_over_ngram_speedup"] = (
+        round(draft["decode_tokens_per_sec"]
+              / spec["decode_tokens_per_sec"], 3)
+        if spec["decode_tokens_per_sec"] else None)
+    if SPEC_MIXED_BENCH:
+        out["spec_mixed"] = _measure_spec_mixed(model_name, quant, rng)
+    return out
+
+
+def _measure_spec_mixed(model_name: str, quant, rng) -> dict:
+    """Spec×mixed composition arm: chat TTFT with speculation AND mixed
+    batching on, against mixed-only. Before the composition landed,
+    enabling spec forfeited the stall-free TTFT win (spec rows and a
+    prefill chunk could not share a device step); now the mixed step
+    carries every running row's verify slice plus the budgeted chunk, so
+    chat TTFT with both on must sit within noise of mixed-only at the
+    same load — that non-regression IS the result, with the spec arm's
+    decode acceleration riding along for free.
+
+    Load shape: SPEC_BATCH repetitive long-decode sessions saturate the
+    batch (the n-gram proposer's home turf, so verify slices are real),
+    then SPEC_CHAT_PROBES short chat prompts arrive serially; each
+    probe's TTFT is measured while the sessions keep decoding, and the
+    sessions' decode progress per step is reported alongside — the
+    composition's actual win is BOTH columns at once (chat TTFT parity
+    with mixed-only while the sessions advance accepted+1 tokens per
+    step instead of one). The step token budget is sized for the verify
+    slices (chat_len + batch*(k+1) — the operator guidance: a budget
+    tuned for 1-token decode rows would starve the chunk once rows widen
+    to S tokens)."""
+    on_tpu = jax.default_backend() == "tpu"
+    page = PAGE if PAGE is not None else (128 if on_tpu else 16)
+    pattern = rng.integers(1, 200, 8).tolist()
+    sess_len = max(64, min(PROMPT_LEN, 256))
+    reps = cdiv(sess_len, len(pattern))
+    sess_prompts = [(pattern * reps)[:sess_len] for _ in range(SPEC_BATCH)]
+    chat_len = 48
+    sess_new, chat_new = 512, 8
+    budget = chat_len + SPEC_BATCH * (SPEC_K + 1)
+    out = {"sessions": SPEC_BATCH, "chat_probes": SPEC_CHAT_PROBES}
+
+    for label, spec in (("mixed_only", False), ("spec_mixed", True)):
+        pages_per_seq = cdiv(sess_len + sess_new + SPEC_K, page) + 2
+        cfg = EngineConfig(
+            model=get_model_config(model_name).replace(quantization=quant),
+            cache=CacheConfig(
+                page_size=page,
+                num_pages=(SPEC_BATCH + 2) * pages_per_seq + 1),
+            scheduler=SchedulerConfig(
+                max_num_seqs=SPEC_BATCH + 2, max_prefill_tokens=chat_len,
+                decode_priority_token_budget=budget,
+                decode_buckets=(1, 2, 4, max(8, SPEC_BATCH + 2)),
+                prefill_buckets=(chat_len, 2 * chat_len),
+                decode_window=DECODE_WINDOW, mixed_batch_enabled=True,
+                spec_decode_enabled=spec, num_speculative_tokens=SPEC_K))
+        engine = LLMEngine(cfg, eos_token_id=None)
+        sess_params = SamplingParams(max_tokens=sess_new, temperature=0.0)
+        chat_params = SamplingParams(max_tokens=chat_new, temperature=0.0)
+        # Warmup: one session + one chat probe compile the families.
+        engine.add_request("warm-s", list(sess_prompts[0]), sess_params)
+        for _ in range(8):
+            engine.step()
+        engine.add_request("warm-c", rng.integers(1, 200, chat_len).tolist(),
+                           chat_params)
+        while engine.has_unfinished_requests():
+            engine.step()
+        # Saturate decode, then probe chat TTFT serially mid-decode.
+        for i, p in enumerate(sess_prompts):
+            engine.add_request(f"s-{i}", list(p), sess_params)
+        for _ in range(SPEC_BATCH + 8):
+            engine.step()
+
+        def probe(rid):
+            prompt = rng.integers(1, 200, chat_len).tolist()
+            t0 = time.perf_counter()
+            engine.add_request(rid, prompt, chat_params)
+            ttft = None
+            while ttft is None and engine.has_unfinished_requests():
+                for o in engine.step():
+                    if o.request_id == rid and o.new_token_ids:
+                        ttft = time.perf_counter() - t0
+                        break
+            engine.abort_request(rid)
+            return ttft if ttft is not None else float("nan")
+
+        # Two unmeasured probes compile the chunk-bearing step families
+        # against the NOW-draftable session batch (warm-c above ran before
+        # the sessions existed, so the spec×mixed shape first appears
+        # here).
+        for i in range(2):
+            probe(f"warm-probe-{i}")
+        # EVERY reported quantity is a measured-window delta over one
+        # consistent baseline (warmup + warm probes excluded), and session
+        # tokens are read off the Sequence OBJECTS — a session that
+        # finishes mid-window keeps its token history, where a
+        # running-set re-scan would silently drop it.
+        kinds0 = dict(engine.obs.step_kind_counts)
+        drafted0 = engine.obs.spec_drafted_tokens
+        accepted0 = engine.obs.spec_accepted_tokens
+        sess_seqs = [s for s in engine.scheduler.running
+                     if s.request_id.startswith("s-")]
+        sess_tokens0 = sum(len(s.output_token_ids) for s in sess_seqs)
+        t0_probe = time.perf_counter()
+        ttfts = [probe(f"chat-{i}") for i in range(SPEC_CHAT_PROBES)]
+        probe_wall = time.perf_counter() - t0_probe
+        sess_tokens = sum(len(s.output_token_ids)
+                          for s in sess_seqs) - sess_tokens0
+        kinds = {k: engine.obs.step_kind_counts[k] - kinds0[k]
+                 for k in kinds0}
+        arm = {
+            "chat_ttft_p50_ms": round(_percentile(ttfts, 0.5) * 1e3, 2),
+            "mixed_steps": kinds["mixed"] + kinds["spec_mixed"],
+            # The throughput half of the composition: how fast the decode
+            # sessions advanced WHILE chat probes were in flight
+            # (spec×mixed rows commit accepted+1 per step; mixed-only
+            # rows commit one).
+            "session_tokens_per_sec": (round(sess_tokens / probe_wall, 1)
+                                       if probe_wall > 0 else None),
+        }
+        if spec:
+            drafted = engine.obs.spec_drafted_tokens - drafted0
+            accepted = engine.obs.spec_accepted_tokens - accepted0
+            arm["spec_mixed_steps"] = kinds["spec_mixed"]
+            arm["spec_steps"] = kinds["spec"] + kinds["spec_mixed"]
+            arm["acceptance_ratio"] = (round(accepted / drafted, 3)
+                                       if drafted else None)
+        out[label] = arm
+        del engine
+        gc.collect()
+    base = out["mixed_only"]["chat_ttft_p50_ms"]
+    out["chat_ttft_spec_over_mixed"] = (
+        round(out["spec_mixed"]["chat_ttft_p50_ms"] / base, 3)
+        if base else None)
     return out
 
 
@@ -2038,9 +2204,17 @@ def assemble_output(results: list[dict], backend: str) -> dict:
         "ttft_decomposition": primary.get("ttft_decomposition"),
         "sampled_over_greedy": primary.get("sampled_over_greedy"),
         "mixed_batch": primary.get("mixed_batch"),
-        # Speculative phase headline (full block in configs[-1].speculative).
+        # Speculative phase headlines (full block in
+        # configs[-1].speculative): n-gram acceptance, and the draft-model
+        # arm's decode throughput over the n-gram arm's (CPU default pairs
+        # the target with an oracle same-arch draft — machinery
+        # validation; the production ratio needs a real small draft on
+        # chip, ROADMAP 1(b)).
         "spec_acceptance_ratio": (primary.get("speculative", {})
                                   .get("spec", {}).get("acceptance_ratio")),
+        "spec_draft_over_ngram_speedup": (
+            primary.get("speculative", {})
+            .get("spec_draft_over_ngram_speedup")),
         # Prefix-reuse phase headline: warm-prefix TTFT as a fraction of
         # cold TTFT (full block in configs[-1].prefix_reuse).
         "prefix_warm_over_cold_ttft": (primary.get("prefix_reuse", {})
@@ -2137,7 +2311,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
             "prefill-else-decode), KGCT_BENCH_SPEC (1=speculative-decoding "
             "phase on a repetitive-suffix workload, default on; 0=skip), "
             "KGCT_BENCH_SPEC_K, KGCT_BENCH_SPEC_BATCH, "
-            "KGCT_BENCH_SPEC_MAX_NEW, KGCT_BENCH_PREFIX (1=prefix-reuse "
+            "KGCT_BENCH_SPEC_MAX_NEW, KGCT_BENCH_SPEC_DRAFT (draft-model "
+            "preset for the two-model arm; default: the target preset at "
+            "the same seed — an oracle draft), KGCT_BENCH_SPEC_MIXED "
+            "(1=spec×mixed chat-TTFT composition arm, default on; "
+            "0=skip), KGCT_BENCH_SPEC_CHAT_PROBES, "
+            "KGCT_BENCH_PREFIX (1=prefix-reuse "
             "phase: cold vs warm shared-prefix TTFT on a prefix-caching "
             "engine, default on; 0=skip), KGCT_BENCH_PREFIX_REQS, "
             "KGCT_BENCH_PREFIX_TAIL, KGCT_BENCH_SWAP (1=kv-swap phase: "
@@ -2182,6 +2361,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
 # further than losing "configs" — the primary metric/value/unit always stay.
 _DROPPABLE_HEADLINE = ("ttft_decomposition", "baseline_bar", "mixed_batch",
                        "sampled_over_greedy", "spec_acceptance_ratio",
+                       "spec_draft_over_ngram_speedup",
                        "prefix_warm_over_cold_ttft",
                        "swap_resume_over_recompute_ttft", "preemptions",
                        "qos_chat_ttft_protected_ratio",

@@ -21,6 +21,7 @@ from .kv_boundary import KVBoundaryRule
 from .migration_state import MigrationStateSafetyRule
 from .tenant_accounting import TenantAccountingSafetyRule
 from .fleet_fetch import FleetFetchBoundaryRule
+from .draft_state import DraftStateBoundaryRule
 
 ALL_RULES = [
     TraceSafetyRule(),
@@ -39,6 +40,7 @@ ALL_RULES = [
     MigrationStateSafetyRule(),
     TenantAccountingSafetyRule(),
     FleetFetchBoundaryRule(),
+    DraftStateBoundaryRule(),
 ]
 
 

@@ -115,17 +115,24 @@ class StepSanitizer:
                 self._spec_writes.pop(rid, None)
                 self._owner[rid] = seq
 
-    def on_spec_dispatch(self, batch) -> None:
+    def on_spec_dispatch(self, batch, seqs=None, token_start: int = 0) -> None:
         """Pre-dispatch check of a spec-verify batch's explicit
         ``slot_mapping``: (a) no write into ANY sequence's committed KV
         region — the slot is resolved through a batch-wide page-ownership
         map, so a mis-AIMED slot is caught whether it lands in the writing
         row's own history or another sequence's (the claimed position
         looks legal either way); (b) no committed-region read while a
-        rejected-draft slot in that region is still stale."""
+        rejected-draft slot in that region is still stale.
+
+        ``seqs``/``token_start``: the spec×mixed step carries its verify
+        slices at a token-axis OFFSET past the prefill chunk, whose writes
+        legitimately target uncommitted prompt positions (guarded
+        statically by KGCT005, like every prefill) — the caller passes the
+        verify rows and where their slots start, and only that region is
+        shadow-checked."""
         self.checks += 1
         ps = self.page_size
-        seqs = batch.seqs
+        seqs = batch.seqs if seqs is None else seqs
         self._sync_batch(seqs)
         # page -> (owning seq, page index in its list). Prefix-cache pages
         # shared by several sequences keep one owner; shared pages are
@@ -135,9 +142,9 @@ class StepSanitizer:
         for seq in seqs:
             for idx, page in enumerate(seq.pages):
                 page_owner.setdefault(page, (seq, idx))
-        seg_ids = np.asarray(batch.seg_ids)
-        positions = np.asarray(batch.positions)
-        slots = np.asarray(batch.slot_mapping)
+        seg_ids = np.asarray(batch.seg_ids)[token_start:]
+        positions = np.asarray(batch.positions)[token_start:]
+        slots = np.asarray(batch.slot_mapping)[token_start:]
         writes: dict = {s.request_id: [] for s in seqs}
         for i in range(len(slots)):
             row = int(seg_ids[i])

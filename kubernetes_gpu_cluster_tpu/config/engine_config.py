@@ -127,6 +127,23 @@ class SchedulerConfig:
     # and drafts the continuation of the most recent match.
     spec_ngram_max: int = 3
     spec_ngram_min: int = 1
+    # Draft-MODEL speculative decoding (engine/spec/draft_model.py): name of
+    # a second, small model preset (e.g. tinyllama-1.1b drafting for
+    # llama-3-8b) run by the SAME engine process with its own paged KV pool.
+    # It replaces the n-gram proposer: k draft tokens per spec step come
+    # from k cheap greedy decode dispatches of the draft model, batched
+    # across all spec rows. None (default) keeps prompt-lookup drafting.
+    # The draft vocab must match the target's (drafts are target token ids).
+    spec_draft_model: Optional[str] = None
+    # Acceptance-adaptive k (engine/spec/adaptive.py): shrink/grow the
+    # per-step draft length from the rolling acceptance ratio, bounded to a
+    # pow-2 ladder in [0, spec_k_max] so the compile family stays one
+    # variant per (ladder rung, decode bucket). k=0 degrades to plain
+    # decode (and plain mixed batching); a cooldown re-probes at k=1 so a
+    # workload shift back toward draftable text is noticed.
+    spec_adaptive_k: bool = False
+    # Ceiling for the adaptive ladder. None = num_speculative_tokens.
+    spec_k_max: Optional[int] = None
     # Multi-tenant QoS (engine/qos.py): the configured priority classes.
     # EMPTY (default) disables the whole QoS layer and is byte-identical
     # to the tier-less scheduler — promotion, priority preemption, and
@@ -136,6 +153,13 @@ class SchedulerConfig:
     # Tier applied to requests that name none (no header, no user match).
     # None = the first configured tier.
     qos_default_tier: Optional[str] = None
+
+    @property
+    def effective_spec_k_max(self) -> int:
+        """Draft-length ceiling: the adaptive ladder's top rung, and the k
+        the proposer is built for."""
+        return (self.spec_k_max if self.spec_k_max is not None
+                else self.num_speculative_tokens)
 
 
 @dataclasses.dataclass(frozen=True)

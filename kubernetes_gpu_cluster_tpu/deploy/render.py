@@ -203,12 +203,42 @@ def _engine_args(spec: dict, role: Optional[str] = None,
     if cfg.get("decodePriorityTokenBudget") is not None:
         args += ["--decode-priority-token-budget",
                  str(cfg["decodePriorityTokenBudget"])]
+    spec_knobs = [k for k in ("specDraftModel", "specAdaptiveK", "specKMax")
+                  if cfg.get(k)]
+    if cfg.get("specKMax") is not None and not cfg.get("specAdaptiveK"):
+        raise ValueError(
+            f"modelSpec '{spec['name']}': specKMax requires "
+            "specAdaptiveK: true (without the controller the ladder "
+            "ceiling has no consumer — it would silently raise the "
+            "static draft length instead)")
+    if spec_knobs and not cfg.get("enableSpecDecode"):
+        # Mirror of the engine CLI's argparse hygiene: a silently dropped
+        # draft-model/adaptive-k knob would leave the operator believing
+        # speculation is tuned while the pod serves plain decode.
+        raise ValueError(
+            f"modelSpec '{spec['name']}': {'/'.join(spec_knobs)} requires "
+            "enableSpecDecode: true")
+    if spec_knobs and _is_multihost(spec):
+        raise ValueError(
+            f"modelSpec '{spec['name']}': {'/'.join(spec_knobs)} does not "
+            "compose with multihost/raySpec or pipelineParallelSize > 1 — "
+            "the engine has no spec-verify forward path under pp meshes "
+            "and the draft model cannot join SPMD lockstep; drop the spec "
+            "knobs or serve the model single-host")
     if cfg.get("enableSpecDecode"):
-        # Speculative decoding: n-gram drafting + batched verification.
+        # Speculative decoding: n-gram drafting + batched verification;
+        # composes with mixed batching (verify slices ride the chunk's
+        # device step) and optionally with a draft MODEL + adaptive k.
         args += ["--enable-spec-decode"]
         if cfg.get("numSpeculativeTokens") is not None:
             args += ["--num-speculative-tokens",
                      str(cfg["numSpeculativeTokens"])]
+        if cfg.get("specDraftModel"):
+            args += ["--spec-draft-model", str(cfg["specDraftModel"])]
+        if cfg.get("specAdaptiveK"):
+            args += ["--spec-adaptive-k"]
+        if cfg.get("specKMax") is not None:
+            args += ["--spec-k-max", str(cfg["specKMax"])]
     qos = _qos_tiers_arg(cfg, f"modelSpec '{spec['name']}'")
     if qos is not None:
         # Multi-tenant QoS: tier table -> weighted fair scheduling,

@@ -130,7 +130,8 @@ class LLMEngine:
     def __init__(self, config: EngineConfig, params=None,
                  eos_token_id: Optional[int] = None,
                  mesh: Optional[jax.sharding.Mesh] = None,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 draft_params=None):
         if config.cache.page_size is None:
             # Backend-derived default (see CacheConfig.page_size).
             ps = 128 if jax.default_backend() == "tpu" else 16
@@ -267,6 +268,30 @@ class LLMEngine:
             self.scheduler.spec_enabled = False
         self._spec_verify_fn = (self._build_spec_verify_fn()
                                 if self.scheduler.spec_enabled else None)
+        # Spec×mixed composition: mixed steps carry verify slices when both
+        # features survived their mesh gating. Without the combined program
+        # the scheduler keeps the pre-composition behavior (spec on
+        # pure-decode steps, plain mixed otherwise).
+        if self.scheduler.spec_enabled and self._mixed_fn is not None:
+            self._spec_mixed_fn = self._build_spec_mixed_fn()
+        else:
+            self._spec_mixed_fn = None
+            self.scheduler.spec_mixed_enabled = False
+        if self.scheduler.spec_enabled:
+            sc = config.scheduler
+            if sc.spec_draft_model:
+                # Two-model speculation: install the draft-model runner
+                # over the scheduler's n-gram proposer. This assignment is
+                # the ONE sanctioned installation site; afterwards the
+                # engine/scheduler touch draft state only through the
+                # proposer seam (KGCT017 draft-state-boundary).
+                from .spec.draft_model import build_draft_runner
+                self.scheduler.spec_proposer = build_draft_runner(
+                    config, sc.spec_draft_model, params=draft_params,
+                    jit_enabled=not config.enforce_eager)
+            ctrl = self.scheduler.spec_controller
+            self.obs.spec_current_k = (ctrl.current_k if ctrl is not None
+                                       else sc.effective_spec_k_max)
         self.stats = EngineStats()
         self.step_count = 0
         # Speculative decode-window chain state (see step()).
@@ -354,11 +379,19 @@ class LLMEngine:
         process holds this flat, so any growth under constant traffic is a
         recompilation storm in progress."""
         fns = [self._prefill_fn, self._prefill_hist_fn, self._mixed_fn,
-               self._decode_fn, self._decode_fn_greedy, self._spec_verify_fn]
+               self._decode_fn, self._decode_fn_greedy, self._spec_verify_fn,
+               self._spec_mixed_fn]
         # The shared pair counts once: swapper and kv_io both run it.
         fns += [self._kv_programs._gather_fn, self._kv_programs._scatter_fn]
-        return sum(fn._cache_size() for fn in fns
-                   if fn is not None and hasattr(fn, "_cache_size"))
+        total = sum(fn._cache_size() for fn in fns
+                    if fn is not None and hasattr(fn, "_cache_size"))
+        # The draft model's decode/prefill programs (read through the
+        # proposer seam): the compile guard and the jit-compiles gauge
+        # must cover the second model's family too.
+        proposer = self.scheduler.spec_proposer
+        if proposer is not None and hasattr(proposer, "compiled_variants"):
+            total += proposer.compiled_variants()
+        return total
 
     def _set_kv_cache(self, kv: KVCache) -> None:
         """Swap-in rebinding seam: the scatter donates the pool, so the
@@ -781,6 +814,97 @@ class LLMEngine:
             return toks, n_acc, lps, tids, tlps, kv
 
         return self._maybe_jit(spec_step, donate_argnums=(1,))
+
+    def _build_spec_mixed_fn(self):
+        """Spec×mixed step (models.forward_spec_mixed): ONE program runs a
+        budgeted chunk of the queue-head prompt AND every running
+        sequence's verify slice. The verify half follows the spec program
+        exactly (lossless accept/resample over all draft positions, counts
+        advanced per accepted token); the chunk half follows the mixed
+        program exactly (history attention, host-resync penalties, one
+        sampled row riding device row R_pad). ``S = k + 1`` is a jit
+        STATIC argument — each ladder rung compiles its own (prefill
+        bucket, row bucket, history width) family, bounded like every
+        other grid (tests/test_compile_guard.py)."""
+        cfg = self.model_config
+        use_pallas = self.use_pallas
+        use_pallas_hist = self.use_pallas_hist
+        attn_mesh = self._gspmd_attn_mesh()
+        V = cfg.vocab_size
+
+        def spec_mixed_step(params, kv: KVCache, S, int_t, logits_idx,
+                            int_b, float_b, chunk_page_table, hist_len,
+                            page_tables, context_lens, out_tokens,
+                            bias_ids, bias_vals, key):
+            # int_t: [4, Tp + R_pad*S]; int_b: [R_pad+1, 3] =
+            # (top_k, seed, top_n); logits_idx: [R_pad*S + 1].
+            R_pad = page_tables.shape[0]
+            meta = MixedMeta(
+                seg_ids=int_t[1], positions=int_t[2], slot_mapping=int_t[3],
+                logits_indices=logits_idx, chunk_page_table=chunk_page_table,
+                hist_len=hist_len, page_tables=page_tables,
+                context_lens=context_lens)
+            hidden, kv, _ = model_lib.forward_spec_mixed(
+                params, cfg, int_t[0], meta, kv, S, use_pallas=use_pallas,
+                use_pallas_hist=use_pallas_hist, attn_mesh=attn_mesh)
+            logits = model_lib.compute_logits(params, cfg, hidden,
+                                              use_pallas=use_pallas)
+            logits = _maybe_bias(
+                logits,
+                jnp.concatenate([jnp.repeat(bias_ids[:R_pad], S, axis=0),
+                                 bias_ids[R_pad:R_pad + 1]], axis=0),
+                jnp.concatenate([jnp.repeat(bias_vals[:R_pad], S, axis=0),
+                                 bias_vals[R_pad:R_pad + 1]], axis=0))
+            spec_logits = logits[:R_pad * S].reshape(R_pad, S, V)
+            Tp = int_t.shape[1] - R_pad * S
+            drafts = int_t[0][Tp:].reshape(R_pad, S)[:, 1:]
+            presence_s, frequency_s = float_b[:R_pad, 2], float_b[:R_pad, 3]
+            counts = jax.lax.cond(
+                jnp.any((presence_s != 0.0) | (frequency_s != 0.0)),
+                lambda ot: build_counts(ot, V),
+                lambda ot: jnp.zeros((R_pad, V), jnp.int32),
+                out_tokens[:R_pad])
+            any_top = jnp.any(int_b[:, 2] > 0)
+            toks_s, n_acc, lps_s, tids_s, tlps_s = spec_verify_sample(
+                spec_logits, drafts, context_lens, key, int_b[:R_pad, 1],
+                float_b[:R_pad, 0], int_b[:R_pad, 0], float_b[:R_pad, 1],
+                presence_s, frequency_s, counts, with_top=any_top)
+            # Chunk row: the mixed path's single sampled row, on the
+            # chunk's last-token logits.
+            cl = logits[R_pad * S:]
+            presence_c, frequency_c = (float_b[R_pad:, 2],
+                                       float_b[R_pad:, 3])
+            cl = jax.lax.cond(
+                jnp.any((presence_c != 0.0) | (frequency_c != 0.0)),
+                lambda l: apply_penalties(
+                    l, build_counts(out_tokens[R_pad:], V),
+                    presence_c, frequency_c),
+                lambda l: l, cl)
+            pos_next = jnp.take(int_t[2], logits_idx[R_pad * S:]) + 1
+            keys_c = row_sample_keys(key, int_b[R_pad:, 1], pos_next)
+            tok_c, lp_c, tid_c, tlp_c = sample_and_logprobs(
+                cl, keys_c, float_b[R_pad:, 0], int_b[R_pad:, 0],
+                float_b[R_pad:, 1], row_keys=True, with_top=any_top)
+            # Assemble [R_pad+1, ...]: the chunk's one token rides column 0
+            # of its row; columns past it are padding the host never reads
+            # (its emit count is pinned to 1).
+            pad_cols = ((0, 0), (0, S - 1))
+            toks = jnp.concatenate(
+                [toks_s, jnp.pad(tok_c[:, None], pad_cols)], axis=0)
+            lps = jnp.concatenate(
+                [lps_s, jnp.pad(lp_c[:, None], pad_cols)], axis=0)
+            tids = jnp.concatenate(
+                [tids_s, jnp.pad(tid_c[:, None], pad_cols + ((0, 0),))],
+                axis=0)
+            tlps = jnp.concatenate(
+                [tlps_s, jnp.pad(tlp_c[:, None], pad_cols + ((0, 0),))],
+                axis=0)
+            return toks, n_acc, lps, tids, tlps, kv
+
+        if self.config.enforce_eager:
+            return spec_mixed_step
+        return jax.jit(spec_mixed_step, static_argnums=(2,),
+                       donate_argnums=(1,))
 
     def _build_decode_fn(self, greedy: bool = False):
         """Multi-step decode: W autoregressive steps inside one XLA program.
@@ -1549,6 +1673,9 @@ class LLMEngine:
                 return drained + self._step_mixed(batch, float_b, step_key)
             if batch.kind == "spec":
                 return drained + self._step_spec(batch, float_b, step_key)
+            if batch.kind == "spec_mixed":
+                return drained + self._step_spec_mixed(batch, float_b,
+                                                       step_key)
             if batch.kind == "prefill":
                 with ph("host_prep"):
                     int_t = jnp.asarray(np.stack(
@@ -1771,6 +1898,7 @@ class LLMEngine:
         drafted = int(draft_lens.sum())
         accepted = int(np.minimum(n_acc_np[:B], draft_lens).sum())
         greedy = bool(np.all(batch.temperature[:B] <= 0))
+        self._observe_spec_outcome(drafted, accepted)
         if self._sanitizer is not None:
             # Before _process_window appends tokens: rejected-draft slots
             # (past each row's accepted prefix) become stale in the shadow.
@@ -1781,7 +1909,108 @@ class LLMEngine:
                                         top_lps=top_l, emit_counts=emit)
         self._last_step_info = (
             "spec", B, "greedy" if greedy else "sampled",
-            {"drafted_tokens": drafted, "accepted_tokens": accepted})
+            {"drafted_tokens": drafted, "accepted_tokens": accepted,
+             "draft_s": batch.draft_time_s})
+        return outs
+
+    def _observe_spec_outcome(self, drafted: int, accepted: int) -> None:
+        """Feed the acceptance-adaptive controller (no-op when static k)
+        and mirror its decision to the kgct_spec_current_k gauge."""
+        ctrl = self.scheduler.spec_controller
+        if ctrl is None:
+            return
+        ctrl.observe(drafted, accepted)
+        self.obs.spec_current_k = ctrl.current_k
+
+    def _step_spec_mixed(self, batch: ScheduledBatch, float_b,
+                         step_key) -> list[RequestOutput]:
+        """Execute one spec×mixed step: every running row advances by
+        ``accepted + 1`` tokens (the spec path's commit) AND the queue-head
+        prompt advances by one budgeted chunk (the mixed path's commit) —
+        one dispatched program. Synchronous like both parents; the chunk
+        row's sampled token is the sequence's first generated token on a
+        final chunk (zombie-discarded while partial), and rejected draft
+        slots roll back by the same overwrite-before-read contract the
+        pure spec step pins."""
+        ph = self.obs.phases.phase
+        chunk_seq = batch.seqs[-1]
+        decode_seqs = batch.seqs[:-1]
+        D = len(decode_seqs)
+        R_pad = batch.page_tables.shape[0]
+        S = batch.spec_S
+        Tp = len(batch.tokens) - R_pad * S
+        if _inject_fault("kv_commit_stomp"):
+            _stomp_committed_slot(batch, self.config.cache.page_size, S,
+                                  token_start=Tp)
+        if self._sanitizer is not None:
+            # Verify slices only: the chunk half's writes target
+            # uncommitted prompt positions by design (KGCT005's static
+            # scope), exactly like the plain mixed step.
+            self._sanitizer.on_spec_dispatch(batch, seqs=decode_seqs,
+                                             token_start=Tp)
+        with ph("host_prep"):
+            int_t = jnp.asarray(np.stack(
+                [batch.tokens, batch.seg_ids, batch.positions,
+                 batch.slot_mapping]))
+            logits_idx = jnp.asarray(batch.logits_indices)
+            int_b = jnp.asarray(np.stack(
+                [batch.top_k, batch.seed, batch.top_n], axis=1))
+            chunk_pt = jnp.asarray(batch.chunk_page_table)
+            page_tables = jnp.asarray(batch.page_tables)
+            context_lens = jnp.asarray(batch.context_lens)
+            out_tokens = self._penalty_out_tokens(batch)
+            bias_ids, bias_vals = self._bias_arrays(batch)
+        self.stats.prefill_tokens += batch.prefill_token_count
+        with ph("device_dispatch"):
+            (toks, n_acc, lps, tids, tlps,
+             self.kv_cache) = self._spec_mixed_fn(
+                self.params, self.kv_cache, S, int_t, logits_idx, int_b,
+                float_b, chunk_pt, jnp.int32(batch.hist_len), page_tables,
+                context_lens, out_tokens, bias_ids, bias_vals, step_key)
+        with ph("device_fetch"):
+            # Compute/transfer split for the TTFT decomposition — the
+            # chunk's first token may land this step, like mixed.
+            t0f = time.perf_counter()
+            toks.block_until_ready()
+            compute_s = time.perf_counter() - t0f
+            toks_np = np.asarray(toks)
+            n_acc_np = np.asarray(n_acc)
+            lps_np = np.asarray(lps)
+            top_i = top_l = None
+            if any(s.params.top_logprobs for s in batch.seqs):
+                top_i = np.asarray(tids)
+                top_l = np.asarray(tlps)
+        self._ttft_transfer_s = max(
+            self.obs.phases.current_durs.get("device_fetch", 0.0)
+            - compute_s, 0.0)
+        # Host row view: the D real verify rows, then the chunk's device
+        # row (R_pad) — matching batch.seqs order for _process_window.
+        sel = list(range(D)) + [R_pad]
+        toks_np = toks_np[sel]
+        lps_np = lps_np[sel]
+        if top_i is not None:
+            top_i = top_i[sel]
+            top_l = top_l[sel]
+        emit = np.ones(D + 1, np.int64)
+        emit[:D] = np.minimum(n_acc_np[:D] + 1, S)
+        draft_lens = batch.draft_lens[:D]
+        drafted = int(draft_lens.sum())
+        accepted = int(np.minimum(n_acc_np[:D], draft_lens).sum())
+        greedy = bool(np.all(batch.temperature <= 0))
+        self._observe_spec_outcome(drafted, accepted)
+        if self._sanitizer is not None:
+            self._sanitizer.on_spec_commit(batch, emit)
+        zombies = {chunk_seq.request_id} if batch.partial else set()
+        with ph("postproc"):
+            outs = self._process_window(batch, toks_np, lps_np, zombies,
+                                        defer=False, top_ids=top_i,
+                                        top_lps=top_l, emit_counts=emit)
+        self._last_step_info = (
+            "spec_mixed", batch.num_seqs, "greedy" if greedy else "sampled",
+            {"prefill_tokens": batch.prefill_token_count,
+             "decode_tokens": int(emit[:D].sum()),
+             "drafted_tokens": drafted, "accepted_tokens": accepted,
+             "draft_s": batch.draft_time_s})
         return outs
 
     def _bias_arrays(self, batch: ScheduledBatch):
@@ -1797,7 +2026,7 @@ class LLMEngine:
             return self._dummy_bias[B]
         ids = np.full((B, LOGIT_BIAS_CAP), -1, np.int32)
         vals = np.zeros((B, LOGIT_BIAS_CAP), np.float32)
-        for s, seq in enumerate(batch.seqs):
+        for s, seq in batch.device_seq_rows():
             lb = seq.params.logit_bias
             if lb:   # validated <= LOGIT_BIAS_CAP at SamplingParams init
                 for j, (tok, bias) in enumerate(lb.items()):
@@ -1816,7 +2045,7 @@ class LLMEngine:
                                               jnp.int32)
             return self._dummy_out[B]
         out = np.full((B, self._out_cap), -1, np.int32)
-        for s, seq in enumerate(batch.seqs):
+        for s, seq in batch.device_seq_rows():
             ids = seq.output_token_ids[:self._out_cap]
             out[s, :len(ids)] = ids
         return jnp.asarray(out)
@@ -2061,19 +2290,23 @@ class LLMEngine:
         return [final[f"req-{i}"] for i in range(len(prompts))]
 
 
-def _stomp_committed_slot(batch, page_size: int, S: int) -> None:
+def _stomp_committed_slot(batch, page_size: int, S: int,
+                          token_start: int = 0) -> None:
     """Chaos helper (``KGCT_FAULT=kv_commit_stomp``): redirect row 0's
     first draft KV write to the sequence's position-0 slot — a REAL write
     into committed history (``num_tokens - 1 > 0`` guarantees position 0
     is committed). The KGCT_SANITIZE KV shadow must refuse the dispatch;
     with the sanitizer off this genuinely corrupts context, which is the
-    point — the harness validates the detector, not a simulation of it."""
+    point — the harness validates the detector, not a simulation of it.
+    ``token_start``: where the verify slices begin on the token axis
+    (spec×mixed offsets them past the prefill chunk)."""
     if not batch.seqs:
         return
     seq = batch.seqs[0]
     if seq.num_tokens < 2 or not seq.pages:
         return
-    batch.slot_mapping[1 if S > 1 else 0] = seq.pages[0] * page_size
+    batch.slot_mapping[token_start + (1 if S > 1 else 0)] = \
+        seq.pages[0] * page_size
 
 
 def _device_free_memory() -> Optional[int]:

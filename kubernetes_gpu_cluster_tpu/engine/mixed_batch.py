@@ -73,6 +73,33 @@ if TYPE_CHECKING:  # pragma: no cover - import cycle guard (scheduler imports us
 logger = get_logger("mixed_batch")
 
 
+def _commit_chunk_progress(sched: "Scheduler", head, end: int, n_rows: int,
+                           final: bool, detail: str) -> int:
+    """Chunk-progress bookkeeping shared by the mixed and spec×mixed
+    builders (one definition: queue-wait stamping, chunk trace event,
+    final-chunk admission + prefix registration). Returns the pre-advance
+    ``hist_len``. ``detail`` labels the partial-chunk log line with the
+    step shape (decode rows vs verify slices)."""
+    from .sequence import SequenceStatus
+
+    hist_len = head.num_prefilled
+    head.num_prefilled = end
+    if head.scheduled_time is None or (
+            head.status == SequenceStatus.PREEMPTED and hist_len == 0):
+        sched.obs.on_scheduled(head, n_rows + 1)
+    sched.obs.on_prefill_chunk(head, hist_len, end, head.num_tokens)
+    if final:
+        sched.waiting.popleft()
+        head.status = SequenceStatus.RUNNING
+        sched.running.append(head)
+        sched._register_prefix(head)
+    else:
+        logger.info("%s prefill chunk [%d:%d) of %d (%s)",
+                    head.request_id, hist_len, end, head.num_tokens, detail,
+                    extra={"request_id": head.request_id})
+    return hist_len
+
+
 def plan_chunk_tokens(remaining: int, n_decode: int, budget: Optional[int],
                       max_prefill_tokens: int) -> int:
     """Token-budget split for one mixed step: ``n_decode`` decode tokens
@@ -94,7 +121,6 @@ def build_mixed_batch(sched: "Scheduler") -> Optional["ScheduledBatch"]:
     progress on the queue head, and running-set admission on a final chunk.
     """
     from .scheduler import ScheduledBatch, _bucket
-    from .sequence import SequenceStatus
 
     sc = sched.config.scheduler
     head = sched.waiting[0]
@@ -219,21 +245,8 @@ def build_mixed_batch(sched: "Scheduler") -> Optional["ScheduledBatch"]:
     logits_indices[D] = chunk - 1          # the chunk's last token's hidden
 
     # -- chunk progress bookkeeping (mirrors Scheduler._schedule_chunk) -----
-    hist_len = head.num_prefilled
-    head.num_prefilled = end
-    if head.scheduled_time is None or (
-            head.status == SequenceStatus.PREEMPTED and hist_len == 0):
-        sched.obs.on_scheduled(head, D + 1)
-    sched.obs.on_prefill_chunk(head, hist_len, end, head.num_tokens)
-    if final:
-        sched.waiting.popleft()
-        head.status = SequenceStatus.RUNNING
-        sched.running.append(head)
-        sched._register_prefix(head)
-    else:
-        logger.info("%s mixed prefill chunk [%d:%d) of %d (+%d decode rows)",
-                    head.request_id, hist_len, end, head.num_tokens, D,
-                    extra={"request_id": head.request_id})
+    hist_len = _commit_chunk_progress(sched, head, end, D, final,
+                                      f"mixed, +{D} decode rows")
 
     seqs = decode_seqs + [head]
     return ScheduledBatch(
@@ -243,3 +256,163 @@ def build_mixed_batch(sched: "Scheduler") -> Optional["ScheduledBatch"]:
         context_lens=context_lens, chunk_page_table=chunk_page_table,
         hist_len=hist_len, partial=not final, prefill_token_count=chunk,
         **sched._sampling_arrays(seqs, R_pad))
+
+
+def build_spec_mixed_batch(sched: "Scheduler") -> Optional["ScheduledBatch"]:
+    """Spec×mixed composition: one device step carrying every running row's
+    ``[last, d_1..d_k]`` VERIFY SLICE plus the budgeted chunk of the
+    queue-head prompt — so enabling speculative decoding no longer forfeits
+    the mixed-batching TTFT win (before this, spec rows and a prefill chunk
+    could not share a dispatched program, and the scheduler had to pick).
+
+    Token-axis layout ``[Tp_bucket | R_pad * S]`` (S = k+1):
+
+        [0:Tp)        the prefill chunk, exactly the mixed layout
+                      (seg 0 on chunk tokens, history attention against
+                      chunk_page_table);
+        [Tp + s*S, Tp + (s+1)*S)
+                      running row s's verify slice, exactly the spec
+                      layout (paged history + S x S causal block); seg_ids
+                      carry the row id (the sanitizer's slot map), the
+                      device derives the split statically from S.
+
+    Sampling rows are the R_pad spec rows plus ONE chunk row that rides
+    device row R_pad (``chunk_device_row``); logits are computed for every
+    verify slot plus the chunk's last token. The compiled family is
+    (prefill bucket x row bucket x history width) per ladder rung S — one
+    more bounded grid, pinned by tests/test_compile_guard.py.
+
+    Policy probes mirror build_mixed_batch (QoS chunk-gate, burst packing,
+    budget split — decode rows claim S tokens EACH, the true forward cost
+    of a verify slice) plus the spec bow-outs (k throttled to 0, rows
+    outside the bucket grid, nothing proposed). Every bow-out returns None
+    and the caller falls through to the PLAIN mixed step, so spec×mixed
+    never costs a composition the engine already had. Window chaining is
+    not in play at this seam: spec steps are synchronous by construction
+    (the next step's drafts depend on this one's accepted tokens), exactly
+    like mixed steps (the next batch depends on chunk progress).
+    """
+    from .scheduler import ScheduledBatch, _bucket
+    from .spec.verifier import collect_proposals, resolve_spec_k
+
+    sc = sched.config.scheduler
+    k = resolve_spec_k(sched)
+    if k < 1:
+        return None               # adaptive floor: plain mixed serves TTFT
+    S = k + 1
+    head = sched.waiting[0]
+    sched._try_prefix_reuse(head)
+
+    # -- policy probes (no state mutation until all pass) -------------------
+    if (sched.qos is not None
+            and (head.num_prefilled > 0
+                 or head.num_tokens > sc.max_prefill_tokens)
+            and sched._qos_defer_chunk(head)):
+        return None
+    # Spec rows bucket like the pure spec step; the chunk rides one row
+    # PAST the bucket, so only the row count itself must stay in the grid.
+    if len(sched.running) > sc.decode_buckets[-1]:
+        return None
+    # Burst packing beats serial mixing — the same probe as the mixed path.
+    if (head.num_prefilled == 0
+            and head.num_tokens <= sc.max_prefill_tokens
+            and len(sched.running) + 2 <= sched.max_num_seqs):
+        packable, total = 0, 0
+        for i in range(min(len(sched.waiting), sched.PREFILL_LOOKAHEAD + 1)):
+            seq = sched.waiting[i]
+            if (seq.num_prefilled == 0
+                    and total + seq.num_tokens <= sc.max_prefill_tokens):
+                packable += 1
+                total += seq.num_tokens
+                if packable >= 2:
+                    return None
+    remaining = head.num_tokens - head.num_prefilled
+    chunk = plan_chunk_tokens(remaining, len(sched.running) * S,
+                              sc.decode_priority_token_budget,
+                              sc.max_prefill_tokens)
+    if chunk <= 0:
+        return None
+    if (head.num_prefilled + chunk >= head.num_tokens
+            and len(sched.running) >= sched.max_num_seqs):
+        return None
+
+    # -- state mutation starts here -----------------------------------------
+    # Verify slices write S KV entries per row before the host sees a
+    # token — the spec growth window, not the mixed path's single token.
+    decode_seqs = sched._grow_decode_pages(window=S)
+    if not decode_seqs or not sched.waiting or sched.waiting[0] is not head:
+        return None
+    proposals, draft_s = collect_proposals(sched, decode_seqs, k)
+    if not any(proposals):
+        return None               # nothing draftable: plain mixed is cheaper
+    chunk = plan_chunk_tokens(remaining, len(decode_seqs) * S,
+                              sc.decode_priority_token_budget,
+                              sc.max_prefill_tokens)
+    if chunk <= 0:
+        return None
+    end = head.num_prefilled + chunk
+    final = end >= head.num_tokens
+    need = cdiv(end, sched.page_size) - len(head.pages)
+    if need > 0:
+        if not sched.allocator.can_allocate(need):
+            return None
+        head.pages.extend(sched.allocator.allocate(need))
+
+    D = len(decode_seqs)
+    ps = sched.page_size
+    max_len = sched.config.effective_max_len
+    Tp = _bucket(chunk, sc.prefill_buckets)
+    R_pad = _bucket(D, sc.decode_buckets)
+    T_pad = Tp + R_pad * S
+    pages_bucket = cdiv(max_len, ps)
+
+    tokens = np.zeros(T_pad, np.int32)
+    seg_ids = np.full(T_pad, -1, np.int32)
+    positions = np.zeros(T_pad, np.int32)
+    slot_mapping = np.zeros(T_pad, np.int32)   # scrap-page slots for padding
+
+    # -- prefill chunk slice [0:Tp) -----------------------------------------
+    tokens[:chunk] = head.all_token_ids[head.num_prefilled:end]
+    seg_ids[:chunk] = 0
+    tok_pos = np.arange(head.num_prefilled, end)
+    positions[:chunk] = tok_pos
+    head_pages = np.asarray(head.pages, np.int64)
+    slot_mapping[:chunk] = (head_pages[tok_pos // ps] * ps + tok_pos % ps)
+    chunk_page_table = sched._chunk_page_table(head)
+
+    # -- verify slices [Tp : Tp + R_pad*S) ----------------------------------
+    # Exactly the spec verifier's per-row layout, offset by Tp (ONE shared
+    # fill — fill_verify_slices — so the slot/scrap contract cannot drift);
+    # padding slices keep scrap-page slots and seg -1.
+    from .spec.verifier import fill_verify_slices
+    slot_mapping[Tp:] = np.arange(R_pad * S, dtype=np.int32) % ps
+    page_tables = np.zeros((R_pad, pages_bucket), np.int32)
+    context_lens = np.zeros(R_pad, np.int32)
+    draft_lens = np.zeros(R_pad, np.int32)
+    fill_verify_slices(decode_seqs, proposals, k, ps, max_len, tokens,
+                       seg_ids, positions, slot_mapping, page_tables,
+                       context_lens, draft_lens, base=Tp)
+
+    # -- sampled rows -------------------------------------------------------
+    # Logits for EVERY verify slot (acceptance needs all draft positions)
+    # plus the chunk's last token, which samples on device row R_pad.
+    logits_indices = np.zeros(R_pad * S + 1, np.int32)
+    logits_indices[:R_pad * S] = Tp + np.arange(R_pad * S)
+    logits_indices[R_pad * S] = chunk - 1
+
+    # -- chunk progress bookkeeping (shared with build_mixed_batch) ---------
+    hist_len = _commit_chunk_progress(
+        sched, head, end, D, final,
+        f"spec-mixed, +{D} verify slices, k={k}")
+
+    seqs = decode_seqs + [head]
+    rows = list(range(D)) + [R_pad]
+    return ScheduledBatch(
+        kind="spec_mixed", seqs=seqs, tokens=tokens, positions=positions,
+        slot_mapping=slot_mapping, seg_ids=seg_ids,
+        logits_indices=logits_indices, page_tables=page_tables,
+        context_lens=context_lens, chunk_page_table=chunk_page_table,
+        hist_len=hist_len, partial=not final, prefill_token_count=chunk,
+        draft_lens=draft_lens, spec_S=S, draft_time_s=draft_s,
+        chunk_device_row=R_pad,
+        **sched._sampling_arrays(seqs, R_pad + 1, rows=rows))

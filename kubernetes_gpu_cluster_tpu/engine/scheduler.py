@@ -67,9 +67,30 @@ class ScheduledBatch:
     # the actual (unpadded) chunk token count for stats/observability.
     chunk_page_table: Optional[np.ndarray] = None  # [1, hist_width]
     prefill_token_count: int = 0
-    # spec only: per-row count of REAL n-gram proposals (rows short of k
-    # were padded with filler drafts; the split feeds acceptance metrics).
+    # spec + spec_mixed: per-row count of REAL proposals (rows short of k
+    # were padded with filler drafts; the split feeds acceptance metrics),
+    # the step's verify-slice width S = k+1 (adaptive k varies it between
+    # steps), and the draft phase's wall time (trace attribution).
     draft_lens: Optional[np.ndarray] = None        # [B_pad]
+    spec_S: Optional[int] = None
+    draft_time_s: float = 0.0
+    # spec_mixed only: the DEVICE sampling row of the chunk sequence
+    # (seqs[-1]). The chunk rides row R_pad — after the R_pad bucketed spec
+    # rows — while seqs holds only the D real decode rows + the chunk, so
+    # host-side per-seq arrays (bias, penalty out_tokens, sampling params)
+    # must target this row for the chunk instead of index D.
+    chunk_device_row: Optional[int] = None
+
+    def device_seq_rows(self):
+        """(device row, seq) pairs — identity except for the spec_mixed
+        chunk row remap. The seam engine-side per-seq array builders
+        iterate so one spelling serves every batch kind."""
+        for s, seq in enumerate(self.seqs):
+            if (self.chunk_device_row is not None
+                    and s == len(self.seqs) - 1):
+                yield self.chunk_device_row, seq
+            else:
+                yield s, seq
     # sampling arrays [B_pad]
     temperature: Optional[np.ndarray] = None
     top_k: Optional[np.ndarray] = None
@@ -112,10 +133,21 @@ class Scheduler:
         # batched draft-verification steps. The engine may clear this after
         # construction (pp/sp meshes have no spec forward path).
         self.spec_enabled = sc.spec_decode_enabled
+        # Spec×mixed composition: mixed steps carry verify slices when both
+        # features are on. The engine clears this (keeping spec and mixed
+        # individually alive) only if the combined program cannot build.
+        self.spec_mixed_enabled = True
         self.spec_proposer = None
+        self.spec_controller = None
         if sc.spec_decode_enabled:
             from .spec.proposer import build_proposer
+            # Host-side n-gram proposer by default; the ENGINE installs the
+            # draft-model runner over it when spec_draft_model is set
+            # (engine/spec/draft_model.py — building it needs params).
             self.spec_proposer = build_proposer(sc)
+            if sc.spec_adaptive_k:
+                from .spec.adaptive import AdaptiveK
+                self.spec_controller = AdaptiveK(sc.effective_spec_k_max)
         self.decode_buckets = sc.decode_buckets
         self.prefill_buckets = sc.prefill_buckets
         self.page_size = config.cache.page_size
@@ -588,7 +620,16 @@ class Scheduler:
         elif batch.kind == "spec":
             for seq in batch.seqs:
                 qos.charge(qos.resolve(seq.params.qos_tier),
-                           sc.num_speculative_tokens + 1)
+                           batch.spec_S or sc.num_speculative_tokens + 1)
+        elif batch.kind == "spec_mixed":
+            # Verify slices charge their full width (the forward really runs
+            # S tokens per row); the chunk charges like a mixed chunk.
+            for seq in batch.seqs[:-1]:
+                qos.charge(qos.resolve(seq.params.qos_tier),
+                           batch.spec_S or sc.num_speculative_tokens + 1)
+            chunk_seq = batch.seqs[-1]
+            qos.charge(qos.resolve(chunk_seq.params.qos_tier),
+                       max(batch.prefill_token_count, 1))
 
     # -- scheduling ---------------------------------------------------------
 
@@ -609,13 +650,27 @@ class Scheduler:
         # admission path looks at the queue.
         if self.qos is not None:
             self._qos_pass()
+        # Acceptance-adaptive speculation at the k=0 floor: tick the idle
+        # cooldown ONCE per schedule call (both the spec and spec-mixed
+        # builders read current_k; ticking inside them would double-count
+        # or — under a long mixed streak — never run at all).
+        if (self.spec_enabled and self.spec_controller is not None
+                and self.spec_controller.current_k == 0):
+            self.spec_controller.tick_idle()
         # Stall-free mixing: when running decodes and waiting prefill work
         # coexist, one device step carries both (engine/mixed_batch.py).
-        # Every other state — and every case mixing cannot serve (no budget
-        # room, no pages for the chunk, batch full) — falls through to the
-        # legacy prefill-else-decode policy unchanged.
+        # With spec decode also on, the step carries every running row's
+        # VERIFY SLICE instead of a single decode token (spec×mixed — spec
+        # no longer forfeits the mixed TTFT win); its bow-outs (k throttled
+        # to 0, nothing proposed, rows out of the bucket grid) fall through
+        # to the plain mixed step, then the legacy prefill-else-decode
+        # policy unchanged.
         if self.mixed_enabled and self.running and self.waiting:
-            from .mixed_batch import build_mixed_batch
+            from .mixed_batch import build_mixed_batch, build_spec_mixed_batch
+            if self.spec_enabled and self.spec_mixed_enabled:
+                batch = build_spec_mixed_batch(self)
+                if batch is not None:
+                    return batch
             batch = build_mixed_batch(self)
             if batch is not None:
                 return batch
@@ -958,27 +1013,36 @@ class Scheduler:
             slot_mapping=slot_mapping, page_tables=page_tables,
             context_lens=context_lens, **self._sampling_arrays(scheduled, B))
 
-    def _sampling_arrays(self, seqs: list[Sequence], B: int) -> dict:
-        temperature = np.zeros(B, np.float32)   # padding rows sample greedily
-        top_k = np.zeros(B, np.int32)
-        top_p = np.ones(B, np.float32)
-        presence = np.zeros(B, np.float32)
-        frequency = np.zeros(B, np.float32)
-        seed = np.full(B, -1, np.int32)
-        prompt_lens = np.zeros(B, np.int32)
-        top_n = np.zeros(B, np.int32)
+    def _sampling_arrays(self, seqs: list[Sequence], B: int,
+                         rows: Optional[list[int]] = None) -> dict:
+        """Per-row sampling parameter arrays [B]. ``rows`` maps seqs[i] to a
+        device row other than i (spec_mixed: the chunk rides row R_pad past
+        the bucketed spec rows); padding rows keep the greedy/no-op
+        defaults."""
+        arrays = dict(
+            temperature=np.zeros(B, np.float32),  # padding samples greedily
+            top_k=np.zeros(B, np.int32),
+            top_p=np.ones(B, np.float32),
+            presence=np.zeros(B, np.float32),
+            frequency=np.zeros(B, np.float32),
+            seed=np.full(B, -1, np.int32),
+            prompt_lens=np.zeros(B, np.int32),
+            top_n=np.zeros(B, np.int32))
         for s, seq in enumerate(seqs):
-            temperature[s] = seq.params.temperature
-            top_k[s] = seq.params.top_k
-            top_p[s] = seq.params.top_p
-            presence[s] = seq.params.presence_penalty
-            frequency[s] = seq.params.frequency_penalty
-            prompt_lens[s] = seq.num_prompt_tokens
-            top_n[s] = seq.params.top_logprobs
-            if seq.params.seed is not None:
-                # OpenAI accepts any integer seed; the device key derivation
-                # wants a non-negative int32, so fold into 31 bits here.
-                seed[s] = seq.params.seed & 0x7fffffff
-        return dict(temperature=temperature, top_k=top_k, top_p=top_p,
-                    presence=presence, frequency=frequency, seed=seed,
-                    prompt_lens=prompt_lens, top_n=top_n)
+            self._fill_sampling_row(arrays, rows[s] if rows else s, seq)
+        return arrays
+
+    @staticmethod
+    def _fill_sampling_row(arrays: dict, row: int, seq: Sequence) -> None:
+        p = seq.params
+        arrays["temperature"][row] = p.temperature
+        arrays["top_k"][row] = p.top_k
+        arrays["top_p"][row] = p.top_p
+        arrays["presence"][row] = p.presence_penalty
+        arrays["frequency"][row] = p.frequency_penalty
+        arrays["prompt_lens"][row] = seq.num_prompt_tokens
+        arrays["top_n"][row] = p.top_logprobs
+        if p.seed is not None:
+            # OpenAI accepts any integer seed; the device key derivation
+            # wants a non-negative int32, so fold into 31 bits here.
+            arrays["seed"][row] = p.seed & 0x7fffffff

@@ -15,6 +15,14 @@ Pieces:
   (prompt-lookup decoding, Saxena-style): drafts by matching the
   sequence's trailing n-gram against its own prompt+output history — no
   draft model weights, so the whole subsystem exercises on CPU in tier-1.
+- ``draft_model``: the two-model rung — a second, small model with its own
+  paged KV pool, run by the same engine process; k greedy decode
+  dispatches batched across all spec rows produce the drafts
+  (``spec_draft_model`` config).
+- ``adaptive``: acceptance-adaptive speculation depth — a per-engine
+  controller moving k along a bounded pow-2 ladder [0, k_max] from the
+  rolling acceptance ratio; k=0 degrades to plain decode
+  (``spec_adaptive_k`` config).
 - ``verifier``: assembles the batched verification step from scheduler
   state — every running sequence's [last_token, d_1..d_k] slice laid out
   on one ragged token axis (per-token seg_ids/positions/slot_mapping, the
@@ -27,8 +35,9 @@ The device program lives in ``engine.LLMEngine._build_spec_verify_fn``
 ``ops.sampling.spec_verify_sample``).
 """
 
+from .adaptive import AdaptiveK, k_ladder
 from .proposer import DraftProposer, NgramProposer, build_proposer
 from .verifier import build_spec_batch
 
-__all__ = ["DraftProposer", "NgramProposer", "build_proposer",
-           "build_spec_batch"]
+__all__ = ["AdaptiveK", "k_ladder", "DraftProposer", "NgramProposer",
+           "build_proposer", "build_spec_batch"]

@@ -20,7 +20,9 @@ from __future__ import annotations
 class DraftProposer:
     """Base proposer interface. ``propose`` returns UP TO ``k`` draft
     token ids continuing ``token_ids`` (fewer — including zero — is fine;
-    the verifier pads the slice)."""
+    the verifier pads the slice). ``self.k`` is the proposer's CEILING;
+    the verifier may ask for fewer via ``propose_batch(seqs, k)`` when the
+    acceptance-adaptive controller has throttled the step's depth."""
 
     def __init__(self, k: int):
         if k < 1:
@@ -29,6 +31,23 @@ class DraftProposer:
 
     def propose(self, token_ids: list[int]) -> list[int]:
         raise NotImplementedError
+
+    def propose_batch(self, seqs, k: int) -> list[list[int]]:
+        """Drafts for every scheduled row of one spec step, ``k <= self.k``
+        tokens each. Host-side proposers derive this from per-row
+        ``propose``; the draft-model runner OVERRIDES it (its k decode
+        dispatches are batched across rows, and it needs request identity
+        to keep its own KV pool in sync)."""
+        return [self.propose(seq.all_token_ids)[:k] for seq in seqs]
+
+    def retain(self, live_request_ids) -> None:
+        """Lifecycle seam, called once per spec round with the scheduler's
+        RUNNING request ids: stateful proposers drop (and free) per-request
+        state for anything no longer running. No-op for host-side
+        proposers. This — like every ``propose*`` call — is part of the
+        ONE sanctioned seam through which engine/scheduler code touches
+        draft state (the KGCT017 draft-state-boundary lint rule polices
+        direct reaches into the draft pool)."""
 
 
 class NgramProposer(DraftProposer):
@@ -65,8 +84,10 @@ class NgramProposer(DraftProposer):
 
 
 def build_proposer(scheduler_config) -> DraftProposer:
-    """Proposer for a SchedulerConfig — the one construction site, so a
-    future ``spec_proposer="draft-model"`` knob dispatches here."""
-    return NgramProposer(scheduler_config.num_speculative_tokens,
+    """HOST-side proposer for a SchedulerConfig. The draft-MODEL proposer
+    (``spec_draft_model``) is installed by the ENGINE over this one —
+    building it needs model params, the KV geometry and the jit policy,
+    none of which the scheduler owns (engine/spec/draft_model.py)."""
+    return NgramProposer(scheduler_config.effective_spec_k_max,
                          ngram_max=scheduler_config.spec_ngram_max,
                          ngram_min=scheduler_config.spec_ngram_min)

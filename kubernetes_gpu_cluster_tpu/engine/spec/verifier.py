@@ -38,6 +38,7 @@ truncating the emitted-token list, and no page is freed or moved
 
 from __future__ import annotations
 
+import time
 from typing import TYPE_CHECKING, Optional
 
 import numpy as np
@@ -49,6 +50,72 @@ if TYPE_CHECKING:  # pragma: no cover - import cycle guard
     from ..scheduler import ScheduledBatch, Scheduler
 
 logger = get_logger("spec.verifier")
+
+
+def resolve_spec_k(sched: "Scheduler") -> int:
+    """This step's draft length: the acceptance-adaptive controller's
+    current rung when configured, else the static config k. Mirrored to
+    the ``kgct_spec_current_k`` gauge on every resolution (a k=0 throttle
+    must be visible on /metrics, not only by the absence of spec steps)."""
+    ctrl = sched.spec_controller
+    k = ctrl.current_k if ctrl is not None else sched.spec_proposer.k
+    sched.obs.spec_current_k = k
+    return k
+
+
+def collect_proposals(sched: "Scheduler", decode_seqs, k: int):
+    """Drafts for this round through the ONE proposer seam: lifecycle
+    retain, then the batched propose (k cheap draft-model decode
+    dispatches, or per-row n-gram lookups), timed for the draft-phase
+    metrics (``kgct_spec_draft_seconds`` / ``kgct_spec_draft_tokens_total``
+    and the spec trace events' draft/verify attribution)."""
+    t0 = time.perf_counter()
+    proposer = sched.spec_proposer
+    proposer.retain(s.request_id for s in sched.running)
+    proposals = [p[:k] for p in proposer.propose_batch(decode_seqs, k)]
+    draft_s = time.perf_counter() - t0
+    sched.obs.on_spec_draft(sum(len(p) for p in proposals), draft_s)
+    return proposals, draft_s
+
+
+def fill_verify_slices(decode_seqs, proposals, k: int, ps: int, max_len: int,
+                       tokens: np.ndarray, seg_ids: np.ndarray,
+                       positions: np.ndarray, slot_mapping: np.ndarray,
+                       page_tables: np.ndarray, context_lens: np.ndarray,
+                       draft_lens: np.ndarray, base: int = 0) -> None:
+    """THE per-row ``[last, d_1..d_k]`` slice layout — one definition for
+    the pure spec step (base 0) and the spec×mixed step (base = the chunk
+    bucket Tp), so the slot-overflow/scrap-page contract, filler padding
+    and page-table fill cannot drift between the two paths.
+
+    Row s occupies token slots [base + s*S, base + (s+1)*S). Short
+    proposals pad by repeating the trailing token: ANY filler keeps greedy
+    exact and sampled lossless (see proposer docstring); repetition just
+    gives the filler a fighting chance on repetitive workloads. Slots
+    past the model cap route to the scrap page, never wrap into real KV
+    (the decode window's substep_meta contract)."""
+    S = k + 1
+    for s, seq in enumerate(decode_seqs):
+        n = seq.num_tokens
+        last_tok = (seq.output_token_ids[-1] if seq.output_token_ids
+                    else seq.prompt_token_ids[-1])
+        drafts = proposals[s]
+        draft_lens[s] = len(drafts)
+        filler = drafts[-1] if drafts else last_tok
+        drafts = drafts + [filler] * (k - len(drafts))
+        row0 = base + s * S
+        tokens[row0:row0 + S] = [last_tok] + drafts
+        seg_ids[row0:row0 + S] = s
+        for i in range(S):
+            pos = n - 1 + i
+            pos_c = min(pos, max_len - 1)
+            positions[row0 + i] = pos_c
+            page = (seq.pages[pos_c // ps] if pos_c // ps < len(seq.pages)
+                    else SCRAP_PAGE)
+            slot_mapping[row0 + i] = (page * ps + pos_c % ps if pos < max_len
+                                      else pos % ps)
+        page_tables[s, :len(seq.pages)] = seq.pages
+        context_lens[s] = n
 
 
 def build_spec_batch(sched: "Scheduler") -> Optional["ScheduledBatch"]:
@@ -76,7 +143,11 @@ def build_spec_batch(sched: "Scheduler") -> Optional["ScheduledBatch"]:
     from ..scheduler import ScheduledBatch, _bucket
 
     sc = sched.config.scheduler
-    k = sched.spec_proposer.k
+    k = resolve_spec_k(sched)
+    if k < 1:
+        # Adaptive throttle at the floor: spec is off until the idle
+        # cooldown re-probes (scheduler ticks the controller).
+        return None
     S = k + 1
     if len(sched.running) > sc.decode_buckets[-1]:
         return None
@@ -84,8 +155,7 @@ def build_spec_batch(sched: "Scheduler") -> Optional["ScheduledBatch"]:
     decode_seqs = sched._grow_decode_pages(window=S)
     if not decode_seqs:
         return None
-    proposals = [sched.spec_proposer.propose(seq.all_token_ids)[:k]
-                 for seq in decode_seqs]
+    proposals, draft_s = collect_proposals(sched, decode_seqs, k)
     if not any(proposals):
         return None
 
@@ -103,38 +173,12 @@ def build_spec_batch(sched: "Scheduler") -> Optional["ScheduledBatch"]:
     page_tables = np.zeros((R_pad, pages_bucket), np.int32)
     context_lens = np.zeros(R_pad, np.int32)
     draft_lens = np.zeros(R_pad, np.int32)
-
-    for s, seq in enumerate(decode_seqs):
-        n = seq.num_tokens
-        last_tok = (seq.output_token_ids[-1] if seq.output_token_ids
-                    else seq.prompt_token_ids[-1])
-        drafts = proposals[s]
-        draft_lens[s] = len(drafts)
-        # Pad short proposals by repeating the trailing token: ANY filler
-        # keeps greedy exact and sampled lossless (see proposer docstring);
-        # repetition just gives the filler a fighting chance on the
-        # repetitive workloads n-gram drafting targets anyway.
-        filler = drafts[-1] if drafts else last_tok
-        drafts = drafts + [filler] * (k - len(drafts))
-        base = s * S
-        tokens[base:base + S] = [last_tok] + drafts
-        seg_ids[base:base + S] = s
-        for i in range(S):
-            pos = n - 1 + i
-            # Same overflow contract as the decode window's substep_meta:
-            # slots past the model cap (or past the request-budget-clamped
-            # page list) write to the scrap page, never wrap into real KV.
-            pos_c = min(pos, max_len - 1)
-            positions[base + i] = pos_c
-            page = (seq.pages[pos_c // ps] if pos_c // ps < len(seq.pages)
-                    else SCRAP_PAGE)
-            slot_mapping[base + i] = (page * ps + pos_c % ps if pos < max_len
-                                      else pos % ps)
-        page_tables[s, :len(seq.pages)] = seq.pages
-        context_lens[s] = n
+    fill_verify_slices(decode_seqs, proposals, k, ps, max_len, tokens,
+                       seg_ids, positions, slot_mapping, page_tables,
+                       context_lens, draft_lens)
 
     return ScheduledBatch(
         kind="spec", seqs=decode_seqs, tokens=tokens, positions=positions,
         slot_mapping=slot_mapping, seg_ids=seg_ids, page_tables=page_tables,
-        context_lens=context_lens, draft_lens=draft_lens,
-        **sched._sampling_arrays(decode_seqs, R_pad))
+        context_lens=context_lens, draft_lens=draft_lens, spec_S=S,
+        draft_time_s=draft_s, **sched._sampling_arrays(decode_seqs, R_pad))

@@ -42,6 +42,7 @@ from ..ops.attention import (
     paged_decode_attention,
     paged_decode_attention_tp,
     mixed_attention,
+    spec_mixed_attention,
     spec_verify_attention,
 )
 
@@ -604,6 +605,41 @@ def forward_mixed(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
     def attn_fn(lp, q, k, v, layer_idx):
         return mixed_attention(
+            q, k, v, meta.seg_ids, meta.positions, kv.k, kv.v,
+            meta.chunk_page_table, meta.hist_len, meta.page_tables,
+            meta.context_lens, scale, n_prefill=n_prefill, layer=layer_idx,
+            use_pallas=use_pallas, use_pallas_hist=use_pallas_hist,
+            attn_mesh=attn_mesh)
+
+    h, k_all, v_all = _layer_scan(params, cfg, h, meta.positions, attn_fn,
+                                  use_pallas=use_pallas)
+    new_kv = KVCache(*write_kv_pages_all(kv.k, kv.v, k_all, v_all,
+                                         meta.slot_mapping))
+    selected = h[meta.logits_indices]
+    return _norm(cfg, selected, params, "final_norm"), new_kv, h
+
+
+def forward_spec_mixed(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                       meta: MixedMeta, kv: KVCache, S: int,
+                       use_pallas=None, use_pallas_hist=None,
+                       attn_mesh=None):
+    """Spec×mixed step: ONE forward over the combined
+    ``[prefill chunk | verify slices]`` token axis — embedding, QKV/MLP
+    matmuls and norms run once for chunk and verify tokens together (the
+    weight streaming a verify step pays is amortized over the chunk riding
+    along, the same economics that motivated mixed batching) — with
+    attention split at the static chunk/verify boundary
+    (ops.attention.spec_mixed_attention). ``S = k+1`` is config-static per
+    compiled shape (the engine passes it as a jit static arg):
+    ``n_prefill = T - R_pad * S``. Returns (normed_selected
+    [R_pad*S + 1, d] — every verify slot plus the chunk's last token —
+    new_kv, raw_hidden [T, d])."""
+    scale = cfg.head_dim ** -0.5
+    h = _embed(params, cfg, tokens, meta.positions)
+    n_prefill = tokens.shape[0] - meta.page_tables.shape[0] * S
+
+    def attn_fn(lp, q, k, v, layer_idx):
+        return spec_mixed_attention(
             q, k, v, meta.seg_ids, meta.positions, kv.k, kv.v,
             meta.chunk_page_table, meta.hist_len, meta.page_tables,
             meta.context_lens, scale, n_prefill=n_prefill, layer=layer_idx,

@@ -190,7 +190,7 @@ class Observability:
         # cumulative prefill/decode token split of mixed steps — feeds the
         # kgct_mixed_step_ratio gauge and the bench mixed readout.
         self.step_kind_counts = {"prefill": 0, "decode": 0, "mixed": 0,
-                                 "spec": 0}
+                                 "spec": 0, "spec_mixed": 0}
         self.mixed_prefill_tokens = 0
         self.mixed_decode_tokens = 0
         # Speculative decoding: cumulative drafted vs accepted draft tokens
@@ -199,6 +199,17 @@ class Observability:
         # counters, and the bench speculative readout.
         self.spec_drafted_tokens = 0
         self.spec_accepted_tokens = 0
+        # Draft PHASE telemetry (n-gram lookups or draft-model dispatches,
+        # measured at the proposer seam): tokens the proposer actually
+        # produced and the wall time spent producing them — splits a spec
+        # step's cost into draft vs verify. Zero-safe when spec is off.
+        self.spec_draft_tokens = 0
+        self.spec_draft_latency = Histogram(
+            "kgct_spec_draft_seconds",
+            "draft-phase wall time per spec step (proposer seam)")
+        # Acceptance-adaptive k: the controller's live rung (None = spec
+        # off -> the gauge is absent from /metrics, never NaN).
+        self.spec_current_k = None
         # Two-tier KV cache: pages moved device<->host (preempt-by-swap +
         # prefix-spill) and the per-transfer latency split by direction —
         # feeds kgct_kv_swap_{out,in}_pages_total and kgct_kv_swap_seconds.
@@ -305,6 +316,13 @@ class Observability:
         self.fleet_spills[outcome] += 1
         self.fleet_bytes["spill"] += n_bytes
 
+    def on_spec_draft(self, n_tokens: int, duration_s: float) -> None:
+        """One draft phase (the proposer-seam call of a spec round):
+        tokens proposed + wall time. Called by the verifier/spec-mixed
+        builders on the worker thread."""
+        self.spec_draft_tokens += n_tokens
+        self.spec_draft_latency.observe(duration_s)
+
     def on_first_token(self, seq, fetch_s: float = 0.0) -> None:
         ttft = seq.first_token_time - seq.arrival_time
         self.ttft.observe(ttft, (_outcome(seq, None),))
@@ -379,7 +397,7 @@ class Observability:
     def on_step(self, step: int, kind: str, batch: int, duration_s: float,
                 new_tokens: int, mode: str = None, prefill_tokens: int = 0,
                 decode_tokens: int = 0, drafted_tokens: int = 0,
-                accepted_tokens: int = 0) -> None:
+                accepted_tokens: int = 0, draft_s: float = 0.0) -> None:
         # Flight-recorder state snapshot, at most once per interval: one
         # monotonic read per step when nothing is due.
         self.flight.maybe_snapshot()
@@ -407,22 +425,48 @@ class Observability:
             # The speculative-decoding signal: of the drafts this step
             # verified, how many committed (emitted tokens = accepted +
             # one bonus per row; new_tokens carries the realized total).
+            # draft/verify phase attribution: the draft half is the
+            # proposer-seam wall time, the verify half is the rest of the
+            # step (dispatch + fetch of the one verify program).
             self.spec_drafted_tokens += drafted_tokens
             self.spec_accepted_tokens += accepted_tokens
             self.tracer.emit("spec", "", batch=batch, tokens=new_tokens,
                              drafted=drafted_tokens, accepted=accepted_tokens,
-                             mode=mode or "greedy")
+                             mode=mode or "greedy",
+                             draft_ms=round(draft_s * 1e3, 3),
+                             verify_ms=round(
+                                 max(duration_s - draft_s, 0.0) * 1e3, 3))
+        elif kind == "spec_mixed":
+            # The composition step counts BOTH ways: its chunk/verify token
+            # split feeds the mixed-batching counters (a spec_mixed step IS
+            # a stall-free step) and its draft outcome feeds the spec
+            # acceptance counters.
+            self.mixed_prefill_tokens += prefill_tokens
+            self.mixed_decode_tokens += decode_tokens
+            self.spec_drafted_tokens += drafted_tokens
+            self.spec_accepted_tokens += accepted_tokens
+            self.tracer.emit("spec_mixed", "", batch=batch,
+                             tokens=new_tokens,
+                             prefill_tokens=prefill_tokens,
+                             drafted=drafted_tokens,
+                             accepted=accepted_tokens,
+                             mode=mode or "greedy",
+                             draft_ms=round(draft_s * 1e3, 3),
+                             verify_ms=round(
+                                 max(duration_s - draft_s, 0.0) * 1e3, 3))
 
     def mixed_step_ratio(self):
-        """Fraction of device steps that were mixed prefill/decode steps, or
-        None before any step ran. Near-zero under mixing-off or idle-prefill
-        regimes; rises with sustained load when stall-free batching is
-        doing its job (every prefill that would have stalled decode rode a
-        mixed step instead)."""
+        """Fraction of device steps that carried a prefill chunk alongside
+        decode work — plain mixed AND spec×mixed steps both count (a
+        spec_mixed step is a stall-free step whose decode half happens to
+        be verify slices), or None before any step ran. Near-zero under
+        mixing-off or idle-prefill regimes; rises with sustained load when
+        stall-free batching is doing its job."""
         total = sum(self.step_kind_counts.values())
         if total <= 0:
             return None
-        return self.step_kind_counts["mixed"] / total
+        return (self.step_kind_counts["mixed"]
+                + self.step_kind_counts["spec_mixed"]) / total
 
     def spec_acceptance_ratio(self):
         """accepted/drafted draft tokens over all spec steps, or None
@@ -530,6 +574,15 @@ class Observability:
         lines.append("# TYPE kgct_spec_accepted_tokens_total counter")
         lines.append("kgct_spec_accepted_tokens_total %d"
                      % self.spec_accepted_tokens)
+        # Acceptance-adaptive k: the live rung. Absent when spec is off
+        # (None), present from engine construction when on — a fresh
+        # scrape is nan-free either way.
+        lines.extend(render_gauge("kgct_spec_current_k",
+                                  self.spec_current_k))
+        lines.append("# TYPE kgct_spec_draft_tokens_total counter")
+        lines.append("kgct_spec_draft_tokens_total %d"
+                     % self.spec_draft_tokens)
+        lines.extend(self.spec_draft_latency.render())
         lines.append("# TYPE kgct_kv_swap_out_pages_total counter")
         lines.append("kgct_kv_swap_out_pages_total %d"
                      % self.swap_pages["out"])

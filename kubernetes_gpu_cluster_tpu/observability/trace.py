@@ -33,9 +33,9 @@ from typing import Optional
 # hit/overflow/remap), "connect_retry" (connect-phase failover), "ttfb"
 # (upstream headers latency), "relay" (stream relay complete, bytes).
 EVENT_KINDS = ("arrival", "queued", "scheduled", "prefill_chunk",
-               "first_token", "decode", "mixed", "spec", "preempt",
-               "swap", "handoff", "migrate", "resume", "finish", "abort",
-               "pick", "connect_retry", "ttfb", "relay", "failover")
+               "first_token", "decode", "mixed", "spec", "spec_mixed",
+               "preempt", "swap", "handoff", "migrate", "resume", "finish",
+               "abort", "pick", "connect_retry", "ttfb", "relay", "failover")
 
 # Events that OPEN / CLOSE a request's async span in the Perfetto export.
 _OPEN = "arrival"

@@ -468,6 +468,55 @@ def mixed_attention(q, k, v, seg_ids, positions, k_pool, v_pool,
     return jnp.concatenate([out_p, out_d], axis=0)
 
 
+def spec_mixed_attention(q, k, v, seg_ids, positions, k_pool, v_pool,
+                         chunk_page_table, hist_len, page_tables,
+                         context_lens, scale, *, n_prefill, layer=None,
+                         use_pallas=None, use_pallas_hist=None,
+                         attn_mesh=None):
+    """Attention for one SPEC×MIXED step: the token axis is
+    ``[prefill chunk | verify slices]`` with a STATIC split at
+    ``n_prefill`` (derived from padded bucket shapes plus the
+    config-static slice width S, so it resolves at trace time).
+
+    - tokens [0:n_prefill): one sequence's prompt chunk — exactly the
+      mixed path's chunk half (``prefill_history_attention``; chunk tokens
+      carry seg 0, padding -1).
+    - tokens [n_prefill:): every running sequence's ``[last, d_1..d_k]``
+      verify slice against the paged pool (``spec_verify_attention``:
+      identical semantics to the pure spec step).
+
+    Both halves read the pool PRE-write and the caller commits all new K/V
+    (chunk AND draft slots) in the one post-scan scatter — the same
+    contract as every other path, so the composition needs no new kernel:
+    it routes each half through the op the pure paths already use. Chunk
+    and verify sequences are disjoint and each half addresses only its own
+    page tables, so cross-attention between the halves is impossible by
+    construction."""
+    qp, kp, vp = q[:n_prefill], k[:n_prefill], v[:n_prefill]
+    qs, ks, vs = q[n_prefill:], k[n_prefill:], v[n_prefill:]
+    # The chunk half's segment view: seg 0 on chunk tokens, -1 elsewhere
+    # (the flat batch carries row ids on the verify slices for the
+    # sanitizer's slot map — the chunk kernel must not see them).
+    segp = jnp.where(seg_ids[:n_prefill] >= 0, 0, -1)
+    posp = positions[:n_prefill]
+    if attn_mesh is not None and use_pallas_hist:
+        out_p = prefill_history_attention_tp(
+            attn_mesh, qp, kp, vp, segp, posp, k_pool, v_pool,
+            chunk_page_table[0], hist_len, scale, layer=layer)
+    else:
+        out_p = prefill_history_attention(
+            qp, kp, vp, segp, posp, k_pool, v_pool, chunk_page_table[0],
+            hist_len, scale, layer=layer,
+            use_pallas=use_pallas_hist if attn_mesh is None else False)
+    # Verify half: XLA path everywhere today (GSPMD-partitionable over
+    # heads under a tp mesh), the same dispatcher seam as the pure spec
+    # step — a Pallas kernel lands behind it without touching this split.
+    out_s = spec_verify_attention(
+        qs, ks, vs, k_pool, v_pool, page_tables, context_lens, scale,
+        layer=layer, use_pallas=use_pallas)
+    return jnp.concatenate([out_p, out_s], axis=0)
+
+
 # ---------------------------------------------------------------------------
 # Tensor-parallel wrappers: Pallas kernels under a GSPMD mesh via shard_map
 # ---------------------------------------------------------------------------

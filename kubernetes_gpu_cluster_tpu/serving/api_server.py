@@ -1908,11 +1908,12 @@ def build_server(config: EngineConfig, tokenizer_path: Optional[str] = None,
                  mesh=None, leader=None, role: str = "both",
                  prefill_pool: Optional[list] = None,
                  peer_pool: Optional[list] = None,
-                 fleet_prefix_cache: bool = False) -> APIServer:
+                 fleet_prefix_cache: bool = False,
+                 draft_params=None) -> APIServer:
     tokenizer = load_tokenizer(tokenizer_path)
     engine = AsyncLLMEngine(config, params=params,
                             eos_token_id=tokenizer.eos_token_id, mesh=mesh,
-                            leader=leader)
+                            leader=leader, draft_params=draft_params)
     return APIServer(engine, tokenizer, model_name or config.model.name,
                      resilience=config.resilience, role=role,
                      prefill_pool=prefill_pool, peer_pool=peer_pool,
@@ -1999,15 +2000,39 @@ def main(argv: Optional[list[str]] = None) -> None:
                    "(default: max_prefill_tokens)")
     p.add_argument("--enable-spec-decode", action="store_true",
                    help="speculative decoding: n-gram/prompt-lookup "
-                   "drafting (no draft model) + single-dispatch batched "
+                   "drafting (default) or a second draft MODEL "
+                   "(--spec-draft-model) + single-dispatch batched "
                    "verification with lossless acceptance — greedy output "
                    "is byte-identical, sampled output keeps the target "
-                   "distribution; wins are workload-dependent (watch "
-                   "kgct_spec_acceptance_ratio)")
-    p.add_argument("--num-speculative-tokens", type=int, default=4,
-                   help="draft length k per spec step (static compile "
-                   "shape; each verify step scores k+1 positions per "
-                   "sequence)")
+                   "distribution; composes with mixed batching (verify "
+                   "slices ride the chunk's device step). Watch "
+                   "kgct_spec_acceptance_ratio / kgct_spec_current_k")
+    p.add_argument("--num-speculative-tokens", type=int, default=None,
+                   help="draft length k per spec step (default 4; each "
+                   "verify step scores k+1 positions per sequence; with "
+                   "--spec-adaptive-k this is the ladder ceiling unless "
+                   "--spec-k-max overrides it). Requires "
+                   "--enable-spec-decode")
+    p.add_argument("--spec-draft-model", default=None,
+                   help="draft-model speculative decoding: a small model "
+                   "preset (e.g. tinyllama-1.1b drafting for llama-3-8b) "
+                   "run by this engine process with its own paged KV "
+                   "pool; replaces n-gram drafting. The draft vocab must "
+                   "match the target's. Requires --enable-spec-decode")
+    p.add_argument("--spec-draft-weights", default=None,
+                   help="checkpoint dir for the draft model (streamed "
+                   "loader); random-init without it (bench/smoke only). "
+                   "Requires --spec-draft-model")
+    p.add_argument("--spec-adaptive-k", action="store_true",
+                   help="acceptance-adaptive draft length: shrink/grow k "
+                   "along a pow-2 ladder in [0, k_max] from the rolling "
+                   "acceptance ratio (k=0 falls back to plain decode and "
+                   "re-probes after a cooldown). Requires "
+                   "--enable-spec-decode")
+    p.add_argument("--spec-k-max", type=int, default=None,
+                   help="ceiling of the adaptive-k ladder (default: "
+                   "--num-speculative-tokens). Requires "
+                   "--enable-spec-decode")
     p.add_argument("--role", choices=list(REPLICA_ROLES), default="both",
                    help="disaggregated prefill/decode serving: 'prefill' "
                    "dedicates this replica to running prompts and exporting "
@@ -2098,6 +2123,25 @@ def main(argv: Optional[list[str]] = None) -> None:
         # Fail loudly: a swallowed group-size flag means the operator
         # believes int4 is active while the model serves unquantized.
         p.error("--quant-group-size requires --quantization int4")
+    if not args.enable_spec_decode:
+        # Same hygiene as --quant-group-size: a swallowed spec knob means
+        # the operator believes speculation is active while the engine
+        # serves plain decode.
+        for flag, val in (("--num-speculative-tokens",
+                           args.num_speculative_tokens),
+                          ("--spec-draft-model", args.spec_draft_model),
+                          ("--spec-k-max", args.spec_k_max),
+                          ("--spec-adaptive-k", args.spec_adaptive_k
+                           or None)):
+            if val is not None:
+                p.error(f"{flag} requires --enable-spec-decode")
+    if args.spec_draft_weights and not args.spec_draft_model:
+        p.error("--spec-draft-weights requires --spec-draft-model")
+    if args.spec_k_max is not None and not args.spec_adaptive_k:
+        # Without the controller the ladder ceiling has no consumer;
+        # letting it silently raise the STATIC draft length would double
+        # verify compute behind the operator's back.
+        p.error("--spec-k-max requires --spec-adaptive-k")
     if args.quantization:
         model_cfg = model_cfg.replace(quantization=args.quantization)
         if args.quant_group_size is not None:
@@ -2128,7 +2172,12 @@ def main(argv: Optional[list[str]] = None) -> None:
             mixed_batch_enabled=not args.disable_mixed_batch,
             decode_priority_token_budget=args.decode_priority_token_budget,
             spec_decode_enabled=args.enable_spec_decode,
-            num_speculative_tokens=args.num_speculative_tokens,
+            num_speculative_tokens=(args.num_speculative_tokens
+                                    if args.num_speculative_tokens is not None
+                                    else 4),
+            spec_draft_model=args.spec_draft_model,
+            spec_adaptive_k=args.spec_adaptive_k,
+            spec_k_max=args.spec_k_max,
             qos_tiers=qos_tiers,
             qos_default_tier=args.qos_default_tier),
         parallel=ParallelConfig(tp=args.tensor_parallel_size,
@@ -2154,6 +2203,18 @@ def main(argv: Optional[list[str]] = None) -> None:
         # shards' byte ranges (host RSS ~ model/world, the 70B story).
         shardings, _ = resolve_shardings(mesh, config.model)
         params = load_weights(args.weights, config.model, shardings=shardings)
+    draft_params = None
+    if args.spec_draft_weights:
+        from ..engine.weights import load_weights as _load_draft
+        # The draft model stays REPLICATED (no shardings): it is small by
+        # construction and spec decode is single-mesh/GSPMD-tp only. Load
+        # in the TARGET's serving dtype — the same coercion
+        # build_draft_runner applies to the config, so the loaded params
+        # match the draft KV pool's dtype.
+        draft_params = _load_draft(
+            args.spec_draft_weights,
+            get_model_config(args.spec_draft_model).replace(
+                dtype=model_cfg.dtype))
     if follower is not None:
         # Rank > 0 of a multi-process mesh: no HTTP API — build the same
         # engine and serve step directives from rank 0 (SPMD lockstep; see
@@ -2196,7 +2257,8 @@ def main(argv: Optional[list[str]] = None) -> None:
                                       args.peer_pool.split(",")
                                       if u.strip()]
                                      if args.peer_pool else None),
-                          fleet_prefix_cache=args.fleet_prefix_cache)
+                          fleet_prefix_cache=args.fleet_prefix_cache,
+                          draft_params=draft_params)
     app = server.build_app()
 
     async def _arm_sigterm(app_):

@@ -42,13 +42,17 @@ class StreamChunk:
 class AsyncLLMEngine:
     def __init__(self, config: EngineConfig, params=None,
                  eos_token_id: Optional[int] = None, mesh=None,
-                 leader=None):
+                 leader=None, draft_params=None):
         """``leader``: serving.multihost.DirectiveLeader when this process
         is rank 0 of a multi-process mesh — every worker-loop iteration's
         (adds, aborts) are broadcast to follower ranks BEFORE the local
-        apply+step so all engines schedule in SPMD lockstep."""
+        apply+step so all engines schedule in SPMD lockstep.
+        ``draft_params``: pre-loaded draft-model weights
+        (--spec-draft-weights); None random-inits when spec_draft_model is
+        configured."""
         self.engine = LLMEngine(config, params=params,
-                                eos_token_id=eos_token_id, mesh=mesh)
+                                eos_token_id=eos_token_id, mesh=mesh,
+                                draft_params=draft_params)
         self.leader = leader
         # resilience.StepWatchdog, set by APIServer: armed around each
         # step() so a hung device dispatch flips /health instead of parking

@@ -152,6 +152,81 @@ def test_spec_load_compile_count_bounded():
         "second identical spec wave triggered new XLA compilations"
 
 
+def _spec_draft_engine(k: int = 3):
+    """Draft-model + adaptive-k + mixed: the full composition — the
+    spec×mixed program family, the draft model's own decode/prefill
+    families, and the adaptive ladder's per-k variants all ride one
+    engine."""
+    from kubernetes_gpu_cluster_tpu.models import llama as model_lib
+    import jax
+
+    cfg = EngineConfig(
+        model=get_model_config("debug-tiny"),
+        cache=CacheConfig(page_size=8, num_pages=129),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_prefill_tokens=32,
+            decode_buckets=DECODE_BUCKETS, prefill_buckets=PREFILL_BUCKETS,
+            decode_window=2, mixed_batch_enabled=True,
+            spec_decode_enabled=True, num_speculative_tokens=k,
+            spec_draft_model="debug-tiny"))
+    params = model_lib.init_params(cfg.model, jax.random.key(0))
+    # Oracle draft (same params): every draft accepts, so spec and
+    # spec_mixed steps fire deterministically at several row buckets.
+    return LLMEngine(cfg, params=params, draft_params=params)
+
+
+def _run_spec_mixed_wave(eng, tag: str) -> None:
+    """Composition wave: a long-lived repetitive session keeps verify
+    slices live (the oracle draft always proposes) while a
+    longer-than-budget prompt chunks and short prompts arrive — chunk +
+    verify slices must share dispatched steps, at more than one row
+    bucket."""
+    rng = np.random.default_rng(2)
+    pattern = rng.integers(1, 500, 4).tolist()
+    sess = SamplingParams(max_tokens=30, temperature=0.0)
+    short = SamplingParams(max_tokens=6, temperature=0.0)
+    eng.add_request(f"{tag}-s0", pattern * 5, sess)
+    for _ in range(3):
+        eng.step()
+    eng.add_request(f"{tag}-s1", pattern * 3, sess)
+    for _ in range(2):
+        eng.step()
+    eng.add_request(f"{tag}-long", pattern * 12, short)   # 48 > 32: chunks
+    eng.add_request(f"{tag}-p", rng.integers(1, 500, 12).tolist(), short)
+    while eng.has_unfinished_requests():
+        eng.step()
+
+
+def test_spec_mixed_draft_load_compile_count_bounded():
+    """The composition's compile families stay bounded and steady-state:
+    spec×mixed adds (prefill bucket x row bucket x history width) per
+    ladder rung, the draft model adds its decode-per-row-bucket and
+    chunked-prefill families — and a second identical wave compiles
+    NOTHING new (the zero-new-compiles bar sustained serving depends on),
+    counted through the same engine seam the kgct_jit_compiles_total
+    gauge reads, draft programs included."""
+    eng = _spec_draft_engine()
+    _run_spec_mixed_wave(eng, "w1")
+    assert eng.obs.step_kind_counts["spec"] > 0
+    assert eng.obs.step_kind_counts["spec_mixed"] > 0, \
+        "simulation never composed a chunk with verify slices"
+    first = _compiled_variants(eng)
+    n_tp, n_rows = len(PREFILL_BUCKETS), len(DECODE_BUCKETS)
+    bound = (n_tp * n_rows          # pure prefill
+             + n_tp * n_rows * 3    # mixed
+             + n_tp * 3             # solo chunk
+             + n_rows * 2           # decode greedy/sampled
+             + n_rows               # spec verify: one per row bucket
+             + n_tp * n_rows * 3    # spec_mixed: (Tp x rows x widths)
+             + n_rows               # draft decode: one per row bucket
+             + 12)                  # draft chunked prefill (T x width grid)
+    assert 0 < first <= bound, (first, bound)
+
+    _run_spec_mixed_wave(eng, "w2")
+    assert _compiled_variants(eng) == first, \
+        "second identical spec×mixed/draft wave triggered new compilations"
+
+
 def _swap_engine():
     """Page-starved pool + host tier: decode growth must preempt-by-swap
     (and restore) during the wave, exercising the gather/scatter programs."""

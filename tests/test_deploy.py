@@ -157,6 +157,55 @@ def test_spec_decode_knobs_map_to_engine_flags():
     assert "--num-speculative-tokens" not in args
 
 
+def test_spec_draft_model_knobs_map_to_engine_flags():
+    """vllmConfig.specDraftModel / specAdaptiveK / specKMax render to
+    --spec-draft-model / --spec-adaptive-k / --spec-k-max; absent renders
+    nothing (n-gram drafting, static k stay the engine defaults)."""
+    values = copy.deepcopy(VALUES)
+    cfg = values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"]
+    cfg["enableSpecDecode"] = True
+    cfg["specDraftModel"] = "tinyllama-1.1b"
+    cfg["specAdaptiveK"] = True
+    cfg["specKMax"] = 8
+    ms = render_values(values)
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args[args.index("--spec-draft-model") + 1] == "tinyllama-1.1b"
+    assert "--spec-adaptive-k" in args
+    assert args[args.index("--spec-k-max") + 1] == "8"
+    ms = render_values(copy.deepcopy(VALUES))
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    for flag in ("--spec-draft-model", "--spec-adaptive-k", "--spec-k-max"):
+        assert flag not in args
+
+
+def test_spec_draft_model_invalid_combos_fail_render():
+    """Draft-model/adaptive-k knobs without enableSpecDecode fail the
+    RENDER (the CLI-hygiene mirror: a silently dropped knob means the
+    operator believes speculation is tuned while the pod serves plain
+    decode), and so do multihost/pp topologies (no spec forward path
+    under pp meshes; the draft model cannot join SPMD lockstep)."""
+    values = copy.deepcopy(VALUES)
+    cfg = values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"]
+    cfg["specDraftModel"] = "tinyllama-1.1b"
+    with pytest.raises(ValueError, match="enableSpecDecode"):
+        render_values(values)
+    values = copy.deepcopy(VALUES)
+    cfg = values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"]
+    cfg["enableSpecDecode"] = True
+    cfg["specAdaptiveK"] = True
+    cfg["pipelineParallelSize"] = 2
+    with pytest.raises(ValueError, match="multihost"):
+        render_values(values)
+    values = copy.deepcopy(VALUES)
+    cfg = values["servingEngineSpec"]["modelSpec"][0]["vllmConfig"]
+    cfg["enableSpecDecode"] = True
+    cfg["specKMax"] = 8             # ceiling without the controller
+    with pytest.raises(ValueError, match="specAdaptiveK"):
+        render_values(values)
+
+
 def test_swap_space_knob_maps_to_engine_flag():
     """vllmConfig.swapSpaceGB renders to the API server's --swap-space-gb
     (the two-tier KV cache's deployment surface, vLLM swapSpace parity);

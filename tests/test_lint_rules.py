@@ -946,3 +946,65 @@ class TestFleetFetchBoundary:  # KGCT016
             def _worker(self):
                 self.engine.import_request("r", [1], None, {})
         """, "KGCT016", relpath="serving/async_engine.py") == []
+
+
+class TestDraftStateBoundary:  # KGCT017
+    def test_direct_draft_kv_reach_fires(self):
+        found = lint("""
+            def step(self):
+                kv = self.scheduler.spec_proposer.kv_cache
+        """, "KGCT017", relpath="engine/engine.py")
+        assert len(found) == 1 and "kv_cache" in found[0].message
+
+    def test_alias_then_allocator_reach_fires(self):
+        """A local alias of the proposer handle must not launder the
+        reach: taint follows simple assignments."""
+        found = lint("""
+            def grow(sched):
+                proposer = sched.spec_proposer
+                pages = proposer.allocator.allocate(2)
+        """, "KGCT017", relpath="engine/scheduler.py")
+        assert len(found) == 1 and "allocator" in found[0].message
+
+    def test_attr_assignment_through_handle_fires(self):
+        found = lint("""
+            def tune(sched):
+                sched.spec_proposer.k = 8
+        """, "KGCT017", relpath="engine/scheduler.py")
+        assert len(found) == 1
+
+    def test_draft_params_rebind_fires(self):
+        found = lint("""
+            def swap_weights(self, params):
+                self.scheduler.spec_proposer.params = params
+        """, "KGCT017", relpath="engine/engine.py")
+        assert len(found) >= 1
+
+    def test_proposer_seam_silent(self):
+        """Installation + the seam methods (propose_batch/retain/k/
+        compiled_variants) are the sanctioned surface."""
+        assert lint("""
+            def build(self, config, seqs):
+                self.scheduler.spec_proposer = build_draft_runner(config)
+                self.scheduler.spec_proposer.retain(ids)
+                drafts = self.scheduler.spec_proposer.propose_batch(seqs, 4)
+                k = self.scheduler.spec_proposer.k
+                proposer = self.scheduler.spec_proposer
+                if hasattr(proposer, "compiled_variants"):
+                    n = proposer.compiled_variants()
+        """, "KGCT017", relpath="engine/engine.py") == []
+
+    def test_spec_package_is_the_implementation(self):
+        """engine/spec/ OWNS the state — the rule polices reaches from
+        outside, not the implementation itself."""
+        assert lint("""
+            def _grow(self, row):
+                self.kv_cache = self.allocator.allocate(1)
+                row.pages = self.spec_proposer.kv_cache
+        """, "KGCT017", relpath="engine/spec/draft_model.py") == []
+
+    def test_outside_engine_scope_silent(self):
+        assert lint("""
+            def f(e):
+                kv = e.scheduler.spec_proposer.kv_cache
+        """, "KGCT017", relpath="serving/api_server.py") == []

@@ -1,0 +1,381 @@
+"""Draft-model speculative decoding + acceptance-adaptive k + spec×mixed.
+
+The bars, mirroring tests/test_spec_decode.py's for the n-gram rung:
+
+1. LOSSLESSNESS with a real draft MODEL: greedy output is byte-identical
+   to non-spec whether the draft is an oracle (same params — everything
+   accepts) or a mismatched model (nearly everything rejects); seeded
+   sampling reproduces. The ops-level chi-square distribution pin is
+   unchanged (the verify sampler never changed — drafts are one-hot q
+   either way).
+2. DRAFT-POOL SYNC: the runner's valid/tail bookkeeping keeps the draft
+   KV consistent across accept/reject/bonus commits with ONE catch-up
+   feed per round in steady state; legacy-decode gaps trigger the reset
+   prefill; retained state dies with the request and frees its pages.
+3. ADAPTIVE K: a garbage draft decays k down the ladder to 0 (spec off,
+   plain decode byte-identical), and the idle cooldown re-probes so a
+   recovered workload climbs back.
+4. SPEC×MIXED: chunk + verify slices ride one dispatched step
+   (step kind "spec_mixed"), byte-identical to the mixed-only engine for
+   greedy AND seeded sampling, abort-mid-chunk releases pages, and the
+   CLI/metrics surfaces are wired (argparse hygiene, kgct_spec_current_k,
+   draft-phase counters, trace attribution).
+
+Tier-1 budget: one module params pytree, short generations, tiny configs;
+the heavier compile-bound pins live in tests/test_compile_guard.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_gpu_cluster_tpu.config import (
+    CacheConfig, EngineConfig, SchedulerConfig, get_model_config)
+from kubernetes_gpu_cluster_tpu.engine import (LLMEngine, SamplingParams,
+                                               Sequence)
+from kubernetes_gpu_cluster_tpu.engine.spec import AdaptiveK, DraftProposer
+from kubernetes_gpu_cluster_tpu.engine.spec.draft_model import (
+    DraftModelRunner, build_draft_runner)
+from kubernetes_gpu_cluster_tpu.models import llama as model_lib
+
+_MODEL = get_model_config("debug-tiny")
+_PARAMS = model_lib.init_params(_MODEL, jax.random.key(7))
+
+REPETITIVE = [7, 3, 9, 11] * 8
+PLAIN = [5, 99, 23, 44, 17, 301, 12]
+
+
+def _cfg(spec: bool, draft=None, adaptive=False, k: int = 4,
+         mixed: bool = False, max_prefill: int = 256, k_max=None):
+    return EngineConfig(
+        model=_MODEL,
+        cache=CacheConfig(page_size=8, num_pages=192),
+        scheduler=SchedulerConfig(
+            max_num_seqs=4, max_prefill_tokens=max_prefill,
+            decode_buckets=(1, 2, 4), prefill_buckets=(32, 64, 128, 256),
+            decode_window=8, mixed_batch_enabled=mixed,
+            spec_decode_enabled=spec, num_speculative_tokens=k,
+            spec_draft_model=draft, spec_adaptive_k=adaptive,
+            spec_k_max=k_max))
+
+
+def make_engine(spec: bool, **kw):
+    draft_params = kw.pop("draft_params", None)
+    return LLMEngine(_cfg(spec, **kw), params=_PARAMS,
+                     draft_params=draft_params)
+
+
+class _GarbageProposer(DraftProposer):
+    def __init__(self, k, token=1):
+        super().__init__(k)
+        self.token = token
+
+    def propose(self, token_ids):
+        return [self.token] * self.k
+
+
+class TestDraftModelByteIdentity:
+    def test_oracle_draft_greedy_identical_and_accepts(self):
+        """Draft == target params: every greedy draft IS the argmax, so
+        acceptance is ~1.0 and output must still be byte-identical to
+        non-spec (the accept rule emits the argmax either way)."""
+        sp = SamplingParams(max_tokens=24, temperature=0.0)
+        prompts = [list(REPETITIVE), list(PLAIN)]
+        ref = [o.output_token_ids
+               for o in make_engine(False).generate(prompts, sp)]
+        eng = make_engine(True, draft="debug-tiny", draft_params=_PARAMS)
+        got = [o.output_token_ids for o in eng.generate(prompts, sp)]
+        assert got == ref
+        assert eng.obs.step_kind_counts["spec"] > 0
+        assert eng.obs.spec_acceptance_ratio() > 0.9
+        assert eng.obs.spec_draft_tokens > 0
+        # both pools drained
+        alloc = eng.scheduler.allocator
+        assert alloc.num_free == alloc.num_pages - 1
+
+    def test_mismatched_draft_greedy_identical(self):
+        """A draft model with DIFFERENT weights drafts mostly-rejected
+        garbage; the rolled-back state must keep the output byte-identical
+        (losslessness does not depend on draft quality)."""
+        sp = SamplingParams(max_tokens=16, temperature=0.0)
+        prompts = [list(REPETITIVE), list(PLAIN)]
+        ref = [o.output_token_ids
+               for o in make_engine(False).generate(prompts, sp)]
+        eng = make_engine(True, draft="debug-tiny")
+        eng.scheduler.spec_proposer = build_draft_runner(
+            eng.config, "debug-tiny", seed=123)
+        got = [o.output_token_ids for o in eng.generate(prompts, sp)]
+        assert got == ref
+        assert eng.obs.step_kind_counts["spec"] > 0
+        ratio = eng.obs.spec_acceptance_ratio()
+        assert ratio is not None and ratio < 0.5
+
+    def test_seeded_sampled_reproducible_with_draft_model(self):
+        sp = SamplingParams(max_tokens=12, temperature=0.9, seed=5)
+        a = make_engine(True, draft="debug-tiny",
+                        draft_params=_PARAMS).generate([list(REPETITIVE)],
+                                                       sp)[0]
+        b = make_engine(True, draft="debug-tiny",
+                        draft_params=_PARAMS).generate([list(REPETITIVE)],
+                                                       sp)[0]
+        assert a.output_token_ids == b.output_token_ids
+
+
+class TestDraftRunnerSync:
+    """Unit pins on the runner's valid/tail bookkeeping — no engine, real
+    Sequence objects driving propose_batch directly."""
+
+    def _runner(self, k=4):
+        return DraftModelRunner(_cfg(True, draft="debug-tiny", k=k),
+                                _MODEL, params=_PARAMS)
+
+    def test_first_round_resets_then_steady_state_is_one_feed(self):
+        r = self._runner()
+        seq = Sequence("r", list(REPETITIVE), SamplingParams())
+        d1 = r.propose_batch([seq], 4)[0]
+        assert len(d1) == 4
+        resets_after_first = r.num_reset_prefills
+        assert resets_after_first >= 1          # prompt ingestion
+        # verifier accepts 2 drafts + resamples a different 3rd token
+        seq.append_token(d1[0])
+        seq.append_token(d1[1])
+        seq.append_token((d1[2] + 1) % _MODEL.vocab_size)
+        d2 = r.propose_batch([seq], 4)[0]
+        assert len(d2) == 4
+        # steady state: gap absorbed by the round's own dispatches
+        assert r.num_reset_prefills == resets_after_first
+
+    def test_all_accepted_plus_bonus_keeps_sync(self):
+        r = self._runner()
+        seq = Sequence("r", list(REPETITIVE), SamplingParams())
+        d1 = r.propose_batch([seq], 4)[0]
+        for t in d1:                       # all k accepted
+            seq.append_token(t)
+        seq.append_token((d1[-1] + 3) % _MODEL.vocab_size)   # bonus
+        resets = r.num_reset_prefills
+        d2 = r.propose_batch([seq], 4)[0]
+        # gap is 2 (d_k's KV was never fed + the bonus): absorbed in-round,
+        # costing one draft slot, no reset
+        assert len(d2) == 3
+        assert r.num_reset_prefills == resets
+
+    def test_legacy_window_gap_triggers_reset(self):
+        r = self._runner(k=3)
+        seq = Sequence("r", list(REPETITIVE), SamplingParams())
+        r.propose_batch([seq], 3)
+        resets = r.num_reset_prefills
+        for t in range(8):                 # a legacy decode window's commits
+            seq.append_token((t * 13 + 5) % _MODEL.vocab_size)
+        d = r.propose_batch([seq], 3)[0]
+        assert len(d) == 3
+        assert r.num_reset_prefills > resets
+
+    def test_retain_frees_dropped_rows_pages(self):
+        r = self._runner()
+        seqs = [Sequence(f"r{i}", list(REPETITIVE), SamplingParams())
+                for i in range(3)]
+        r.propose_batch(seqs, 4)
+        free_mid = r.allocator.num_free
+        assert free_mid < r.allocator.num_pages - 1
+        r.retain(["r0"])                   # r1/r2 finished
+        assert r.allocator.num_free > free_mid
+        r.retain([])
+        assert r.allocator.num_free == r.allocator.num_pages - 1
+
+    def test_vocab_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="vocab"):
+            DraftModelRunner(_cfg(True, draft="opt-125m"),
+                             get_model_config("opt-125m"))
+
+
+class TestAdaptiveK:
+    def test_ladder_and_moves(self):
+        c = AdaptiveK(k_max=6, window=2)
+        assert c.ladder == (0, 1, 2, 4, 6)
+        assert c.current_k == 6
+        c.observe(12, 0)
+        c.observe(12, 0)                   # window full, ratio 0 -> down
+        assert c.current_k == 4
+        for _ in range(3 * 2):
+            c.observe(12, 0)
+        assert c.current_k == 0            # decayed to the floor
+        for _ in range(c.cooldown):
+            c.tick_idle()
+        assert c.current_k == 1            # re-probe at the smallest rung
+        c.observe(10, 10)
+        c.observe(10, 10)                  # ratio 1 -> climb
+        assert c.current_k == 2
+
+    def test_engine_garbage_draft_decays_to_zero_and_recovers(self):
+        """End-to-end throttle: a garbage proposer drags k to 0 (steps
+        revert to plain decode — byte-identical output), and the idle
+        cooldown re-probes so a good proposer climbs back."""
+        sp = SamplingParams(max_tokens=72, temperature=0.0)
+        eng = make_engine(True, adaptive=True, k=4)
+        eng.scheduler.spec_proposer = _GarbageProposer(4, token=1)
+        ctrl = eng.scheduler.spec_controller
+        ctrl.window = 3
+        ctrl.cooldown = 6
+        ref = make_engine(False).generate([list(REPETITIVE)], sp)[0]
+        out = eng.generate([list(REPETITIVE)], sp)[0]
+        assert out.output_token_ids == ref.output_token_ids
+        assert ctrl.num_steps_down >= 3          # rode the ladder down
+        assert eng.obs.step_kind_counts["decode"] > 0   # k=0 stretches
+        # gauge mirrors the live rung
+        assert eng.obs.spec_current_k == ctrl.current_k
+        # recovery: cooldown ticks at k=0 re-probe, and a now-useful
+        # proposer climbs
+        ctrl.current_k = 0
+        ctrl._idle_ticks = 0
+        eng.scheduler.spec_proposer = build_draft_runner(
+            eng.config, "debug-tiny", params=_PARAMS)
+        out2 = eng.generate([list(REPETITIVE)], sp)[0]
+        assert out2.output_token_ids == ref.output_token_ids
+        assert ctrl.current_k >= 1
+        assert ctrl.num_steps_up >= 1
+
+
+class TestSpecMixedInterop:
+    def _staggered(self, eng):
+        """One session decodes (draftable history), then a long chunking
+        prompt + a short one arrive — chunk and verify slices must share
+        steps."""
+        sp = SamplingParams(max_tokens=20, temperature=0.0)
+        outs = {}
+        eng.add_request("a", list(REPETITIVE), sp)
+        for _ in range(10):
+            for o in eng.step():
+                if o.finished:
+                    outs[o.request_id] = o.output_token_ids
+        eng.add_request("b", REPETITIVE * 3, sp)
+        eng.add_request("c", list(REPETITIVE), sp)
+        while eng.has_unfinished_requests():
+            for o in eng.step():
+                if o.finished:
+                    outs[o.request_id] = o.output_token_ids
+        return outs
+
+    def test_chunk_plus_verify_slices_in_one_step(self):
+        ref = self._staggered(make_engine(False, mixed=True,
+                                          max_prefill=32))
+        eng = make_engine(True, mixed=True, max_prefill=32,
+                          draft="debug-tiny", draft_params=_PARAMS)
+        got = self._staggered(eng)
+        assert got == ref
+        assert eng.obs.step_kind_counts["spec_mixed"] > 0
+        # spec_mixed steps count toward the stall-free ratio
+        assert eng.obs.mixed_step_ratio() > 0
+        alloc = eng.scheduler.allocator
+        assert alloc.num_free == alloc.num_pages - 1
+
+    def test_seeded_sampled_step_grouping_independent(self):
+        """Seeded verify keys derive from (seed, position) and a greedy
+        draft model's proposals are state-deterministic, so HOW steps
+        group (verify slices sharing a chunk's step vs pure spec steps)
+        must not change a seeded stream byte-for-byte. (Seeded spec vs
+        NON-spec is distribution-equal, not byte-equal — accept/resample
+        consumes different randomness than a direct draw; the chi-square
+        pin in test_spec_decode covers that contract.)"""
+        sp = SamplingParams(max_tokens=16, temperature=0.8, seed=11)
+        prompts = [REPETITIVE * 3, list(REPETITIVE)]
+        ref = [o.output_token_ids for o in
+               make_engine(True, mixed=False, max_prefill=32,
+                           draft="debug-tiny",
+                           draft_params=_PARAMS).generate(prompts, sp)]
+        eng = make_engine(True, mixed=True, max_prefill=32,
+                          draft="debug-tiny", draft_params=_PARAMS)
+        got = [o.output_token_ids for o in eng.generate(prompts, sp)]
+        assert got == ref
+        assert eng.obs.step_kind_counts["spec_mixed"] > 0
+
+    def test_abort_mid_chunk_with_spec_rows(self):
+        """Aborting the mid-chunk head while verify slices share its steps
+        frees exactly the chunk's pages; the surviving spec rows keep
+        decoding to completion."""
+        eng = make_engine(True, mixed=True, max_prefill=32,
+                          draft="debug-tiny", draft_params=_PARAMS)
+        sp = SamplingParams(max_tokens=24, temperature=0.0)
+        eng.add_request("a", list(REPETITIVE), sp)
+        for _ in range(6):
+            eng.step()
+        free0 = eng.scheduler.allocator.num_free
+        eng.add_request("long", REPETITIVE * 3, sp)
+        eng.step()                          # chunk rides a (spec_)mixed step
+        head = eng.scheduler.waiting[0]
+        assert head.request_id == "long" and head.num_prefilled > 0
+        held = len(head.pages)
+        free_mid = eng.scheduler.allocator.num_free
+        assert held > 0
+        assert eng.abort_request("long")
+        assert eng.scheduler.allocator.num_free == free_mid + held
+        while eng.has_unfinished_requests():
+            eng.step()
+        alloc = eng.scheduler.allocator
+        assert alloc.num_free == alloc.num_pages - 1
+        assert free0 <= alloc.num_free
+
+
+class TestSpecCLIHygiene:
+    """Argparse hygiene: spec knobs without --enable-spec-decode are loud
+    CLI errors (the --quant-group-size pattern — a swallowed knob means
+    the operator believes speculation is configured while the engine
+    serves plain decode)."""
+
+    @pytest.mark.parametrize("argv", [
+        ["--num-speculative-tokens", "4"],
+        ["--spec-draft-model", "tinyllama-1.1b"],
+        ["--spec-adaptive-k"],
+        ["--spec-k-max", "8"],
+    ])
+    def test_spec_flags_require_enable_spec_decode(self, argv):
+        from kubernetes_gpu_cluster_tpu.serving.api_server import main
+        with pytest.raises(SystemExit) as e:
+            main(["--model", "debug-tiny"] + argv)
+        assert e.value.code == 2
+
+    def test_draft_weights_require_draft_model(self):
+        from kubernetes_gpu_cluster_tpu.serving.api_server import main
+        with pytest.raises(SystemExit) as e:
+            main(["--model", "debug-tiny", "--enable-spec-decode",
+                  "--spec-draft-weights", "/tmp/nope"])
+        assert e.value.code == 2
+
+    def test_k_max_requires_adaptive_k(self):
+        """Without the controller the ladder ceiling has no consumer —
+        silently raising the STATIC draft length would double verify
+        compute behind the operator's back."""
+        from kubernetes_gpu_cluster_tpu.serving.api_server import main
+        with pytest.raises(SystemExit) as e:
+            main(["--model", "debug-tiny", "--enable-spec-decode",
+                  "--spec-k-max", "8"])
+        assert e.value.code == 2
+
+
+class TestSpecDraftObservability:
+    def test_current_k_gauge_and_draft_counters(self):
+        eng = make_engine(True, draft="debug-tiny", draft_params=_PARAMS)
+        text = "\n".join(eng.obs.render_prometheus())
+        # fresh spec-on engine: gauge present at the static k, counters 0
+        assert "kgct_spec_current_k 4" in text
+        assert "kgct_spec_draft_tokens_total 0" in text
+        assert "kgct_spec_draft_seconds" in text
+        eng.generate([list(REPETITIVE)],
+                     SamplingParams(max_tokens=16, temperature=0.0))
+        text = "\n".join(eng.obs.render_prometheus())
+        assert "kgct_spec_draft_tokens_total 0" not in text
+        assert eng.obs.spec_draft_tokens > 0
+
+    def test_current_k_absent_when_spec_off(self):
+        eng = make_engine(False)
+        text = "\n".join(eng.obs.render_prometheus())
+        assert "kgct_spec_current_k" not in text
+        assert "kgct_spec_draft_tokens_total 0" in text   # zero-safe
+
+    def test_spec_trace_events_carry_phase_attribution(self):
+        eng = make_engine(True, draft="debug-tiny", draft_params=_PARAMS)
+        eng.generate([list(REPETITIVE)],
+                     SamplingParams(max_tokens=16, temperature=0.0))
+        evs = [e for e in eng.obs.tracer.events() if e.kind == "spec"]
+        assert evs
+        assert "draft_ms" in evs[0].args and "verify_ms" in evs[0].args
