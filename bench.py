@@ -1488,7 +1488,7 @@ def _measure_fleet_cache() -> dict:
                 return float(line.rpartition(" ")[2])
         return 0.0
 
-    async def run_arm(fleet_on: bool) -> dict:
+    async def run_arm(fleet_on: bool, integrity: bool = True) -> dict:
         runners = []
 
         async def serve(**kw):
@@ -1500,10 +1500,12 @@ def _measure_fleet_cache() -> dict:
             runners.append(runner)
             return f"http://127.0.0.1:{runner.addresses[0][1]}"
 
-        out: dict = {"fleet_cache": fleet_on}
+        out: dict = {"fleet_cache": fleet_on, "integrity": integrity}
         try:
-            owner_url = await serve(fleet_prefix_cache=fleet_on)
+            owner_url = await serve(fleet_prefix_cache=fleet_on,
+                                    integrity_checks=integrity)
             puller_url = await serve(fleet_prefix_cache=fleet_on,
+                                     integrity_checks=integrity,
                                      peer_pool=[owner_url])
             async with aiohttp.ClientSession() as sess:
                 async def complete(base, prompt, hint=None):
@@ -1563,13 +1565,24 @@ def _measure_fleet_cache() -> dict:
         "shared_prefix_tokens": shared_len,
         "tail_tokens": tail,
     }
-    for label, fleet_on in (("recompute", False), ("pull", True)):
-        out[label] = asyncio.run(run_arm(fleet_on))
+    # Third arm: the pull path with the wire-integrity layer off — the
+    # checksum cost (encode-side CRC folds + decode-side re-verify) is
+    # the only difference, so the ratio IS the integrity overhead on the
+    # wire path. Droppable: dashboards treat an absent ratio as "not
+    # measured", never as 1.0.
+    for label, fleet_on, integrity in (("recompute", False, True),
+                                       ("pull", True, True),
+                                       ("pull_integrity_off", True, False)):
+        out[label] = asyncio.run(run_arm(fleet_on, integrity))
         gc.collect()
     pull, rec = out["pull"], out["recompute"]
     out["fleet_prefix_pull_over_recompute_ttft"] = (
         round(pull["warm_ttft_p50_ms"] / rec["warm_ttft_p50_ms"], 3)
         if rec["warm_ttft_p50_ms"] else None)
+    off = out["pull_integrity_off"]
+    out["kv_integrity_overhead_ratio"] = (
+        round(pull["warm_ttft_p50_ms"] / off["warm_ttft_p50_ms"], 3)
+        if off["warm_ttft_p50_ms"] else None)
     return out
 
 
@@ -2243,6 +2256,13 @@ def assemble_output(results: list[dict], backend: str) -> dict:
         "fleet_prefix_pull_over_recompute_ttft": (
             primary.get("fleet_cache", {})
             .get("fleet_prefix_pull_over_recompute_ttft")),
+        # Wire-integrity headline: pull-arm warm TTFT with the per-page
+        # checksum layer ON as a fraction of the same pull with it OFF
+        # (~1.0 = the CRC folds and import-seam re-verify are in the
+        # noise; the A/B's third arm in configs[-1].fleet_cache).
+        "kv_integrity_overhead_ratio": (
+            primary.get("fleet_cache", {})
+            .get("kv_integrity_overhead_ratio")),
         # Disaggregation phase headline: sustained decode TPOT p95 through
         # the role-split prefill/decode topology as a fraction of the
         # colocated topology's, from one router scrape per arm (full A/B
@@ -2367,6 +2387,7 @@ _DROPPABLE_HEADLINE = ("ttft_decomposition", "baseline_bar", "mixed_batch",
                        "qos_chat_ttft_protected_ratio",
                        "router_affinity_warm_over_li_ttft",
                        "fleet_prefix_pull_over_recompute_ttft",
+                       "kv_integrity_overhead_ratio",
                        "disagg_tpot_over_colocated",
                        "drain_migrate_over_wait_seconds",
                        "slo_ttft_attainment_ratio",

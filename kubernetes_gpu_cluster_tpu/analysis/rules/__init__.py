@@ -22,6 +22,7 @@ from .migration_state import MigrationStateSafetyRule
 from .tenant_accounting import TenantAccountingSafetyRule
 from .fleet_fetch import FleetFetchBoundaryRule
 from .draft_state import DraftStateBoundaryRule
+from .wire_integrity import WireIntegrityRule
 
 ALL_RULES = [
     TraceSafetyRule(),
@@ -41,6 +42,7 @@ ALL_RULES = [
     TenantAccountingSafetyRule(),
     FleetFetchBoundaryRule(),
     DraftStateBoundaryRule(),
+    WireIntegrityRule(),
 ]
 
 

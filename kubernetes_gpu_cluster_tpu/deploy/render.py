@@ -272,6 +272,13 @@ def _engine_args(spec: dict, role: Optional[str] = None,
         args += ["--fleet-prefix-cache"]
         if peer_urls and not peers_emitted:
             args += ["--peer-pool", ",".join(peer_urls)]
+    if cfg.get("integrityChecks") is False:
+        # KV wire-plane integrity (per-page checksums + frame digest on
+        # every handoff/prefix/spill/migration frame) defaults ON — only
+        # an explicit ``integrityChecks: false`` renders the opt-out
+        # (wire bytes byte-identical to the pre-integrity encoders, for
+        # mixed fleets mid-upgrade); absent/true renders nothing.
+        args += ["--no-integrity-checks"]
     # enableChunkedPrefill needs no flag: long prompts always chunk here.
     if os.path.isabs(str(spec["modelURL"])):
         # Local checkpoint dir (hostPath-mounted): weights + tokenizer live

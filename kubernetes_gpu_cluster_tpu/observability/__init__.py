@@ -233,6 +233,19 @@ class Observability:
         self.fleet_pull_latency = Histogram(
             "kgct_fleet_prefix_pull_seconds",
             "remote prefix pull wall latency (fetch + streamed import)")
+        # KV wire integrity (serving/handoff.py): detections by wire path
+        # x outcome — "corrupt" (a frame failed its own checksums) and
+        # "skew" (a peer spoke the pre-integrity dialect to a receiver
+        # that requires checksums). Every cell pre-seeded: a fresh scrape
+        # renders zeros for the full matrix, integrity off included.
+        self.wire_corruptions = {
+            (path, outcome): 0
+            for path in ("handoff", "prefix", "spill", "migrate", "resume")
+            for outcome in ("corrupt", "skew")}
+        # Peer quarantine entries by peer URL. Bounded cardinality: the
+        # label set is the configured allowlists (peer pool + prefill
+        # pool), seeded at server construction so idle peers render 0.
+        self.peer_quarantines: dict = {}
 
     # -- multi-tenant QoS ----------------------------------------------------
 
@@ -315,6 +328,27 @@ class Observability:
             outcome = "error"
         self.fleet_spills[outcome] += 1
         self.fleet_bytes["spill"] += n_bytes
+
+    def on_wire_corruption(self, path: str, outcome: str = "corrupt"
+                           ) -> None:
+        """One integrity detection on a KV wire path (bounded label
+        matrix — unknown spellings fold into handoff/corrupt so
+        cardinality can never grow)."""
+        if (path, outcome) not in self.wire_corruptions:
+            path, outcome = "handoff", "corrupt"
+        self.wire_corruptions[(path, outcome)] += 1
+
+    def seed_peers(self, peers) -> None:
+        """Pre-seed the quarantine counter's label set from the
+        configured allowlists — zeros for every known peer on a fresh
+        scrape, and the only way labels enter (bounded cardinality)."""
+        for peer in peers:
+            self.peer_quarantines.setdefault(peer, 0)
+
+    def on_peer_quarantine(self, peer: str) -> None:
+        """One quarantine ENTRY for ``peer`` (window extensions do not
+        re-count)."""
+        self.peer_quarantines[peer] = self.peer_quarantines.get(peer, 0) + 1
 
     def on_spec_draft(self, n_tokens: int, duration_s: float) -> None:
         """One draft phase (the proposer-seam call of a spec round):
@@ -604,6 +638,17 @@ class Observability:
             lines.append(f'kgct_fleet_prefix_bytes_total{{dir="{d}"}} '
                          f"{self.fleet_bytes[d]}")
         lines.extend(self.fleet_pull_latency.render())
+        # KV wire integrity: the full path x outcome matrix pre-seeded.
+        lines.append("# TYPE kgct_kv_wire_corruptions_total counter")
+        for (path, oc) in sorted(self.wire_corruptions):
+            lines.append(
+                f'kgct_kv_wire_corruptions_total{{path="{path}",'
+                f'outcome="{oc}"}} {self.wire_corruptions[(path, oc)]}')
+        # Peer quarantines: labels only from the seeded allowlists.
+        lines.append("# TYPE kgct_peer_quarantines_total counter")
+        for peer in sorted(self.peer_quarantines):
+            lines.append(f'kgct_peer_quarantines_total{{peer="{peer}"}} '
+                         f"{self.peer_quarantines[peer]}")
         return lines
 
     def export_perfetto(self) -> dict:

@@ -47,6 +47,18 @@ point (grep for ``inject(`` / ``fault_value(``):
                        deterministically blows its max_concurrent budget
                        and absorbs 429s while higher tiers' admission is
                        untouched (the overload-isolation chaos drill)
+- ``kv_wire_corrupt``  KV wire plane: a byte of the encoded frame is
+                       flipped IN TRANSIT at the client/push seam (fleet
+                       pull chunk, handoff pull blob, migration push,
+                       spill frame) -> the integrity layer must detect
+                       it, abort the import, recompute byte-identically,
+                       and decay the peer's score toward quarantine
+- ``peer_stale_frame`` KV wire plane, serve side: the exporter serves a
+                       frame with a mismatched model header (default) or,
+                       with ``value`` = 1, speaks the pre-integrity wire
+                       dialect -> the receiver's model check / protocol
+                       negotiation rejects it loudly (426-style) instead
+                       of attempting a decode
 
 Params (all optional): ``p`` fire probability in [0, 1] (default 1; drawn
 from a PRIVATE ``random.Random(seed)`` per rule, so sequences are

@@ -61,6 +61,7 @@ from ..observability import Histogram
 from ..resilience import (AdmissionController, DrainState, ResilienceHub,
                           StepWatchdog)
 from ..resilience.drain import drain_and_notify
+from ..resilience.faults import fault_value as _fault_value
 from ..resilience.faults import inject as _inject_fault
 from ..utils import get_logger
 from .async_engine import AsyncLLMEngine
@@ -70,13 +71,14 @@ from .errors import (MIGRATE_URL_HEADER, PREFILL_URL_HEADER,
                      REQUEST_ID_HEADER, RESUME_MODE_HEADER,
                      StreamMigratedError, valid_request_id)
 from .errors import overloaded_error as _overloaded
-from .fleet_cache import SpillQueue, build_pull_policy
+from .fleet_cache import PeerScoreboard, SpillQueue, build_pull_policy
 from .handoff import (HANDOFF_TIMEOUT_S, MIGRATE_PUSH_TIMEOUT_S,
                       PREFIX_PULL_TIMEOUT_S, MigrationStore,
-                      PrefixStreamDecoder, decode_handoff, decode_spill_frame,
+                      PrefixStreamDecoder, ProtocolSkewError,
+                      WireCorruptionError, decode_handoff, decode_spill_frame,
                       encode_handoff, encode_prefix_frames,
                       encode_spill_frame, fetch_handoff, handoff_request_body,
-                      push_handoff)
+                      push_handoff, verify_import_state)
 from .metrics import Metrics
 from .tokenizer import (IncrementalDetokenizer, Tokenizer,
                         apply_chat_template, load_tokenizer)
@@ -236,7 +238,8 @@ class APIServer:
                  role: str = "both",
                  prefill_pool: Optional[list] = None,
                  peer_pool: Optional[list] = None,
-                 fleet_prefix_cache: bool = False):
+                 fleet_prefix_cache: bool = False,
+                 integrity_checks: bool = True):
         if role not in REPLICA_ROLES:
             raise ValueError(f"unknown replica role {role!r} "
                              f"(known: {', '.join(REPLICA_ROLES)})")
@@ -285,6 +288,29 @@ class APIServer:
         # misbehaving prefill replica must not balloon this process.
         kv = engine.engine.kv_cache
         self._handoff_max_bytes = int(kv.k.nbytes + kv.v.nbytes) + (1 << 20)
+        # Spill frames carry ONE page of K and V: bound the /internal/
+        # fleet_spill body to that plus header slack — same derive-from-
+        # the-local-pool discipline as the handoff bound, checked on
+        # Content-Length BEFORE the body is buffered.
+        self._spill_max_bytes = (
+            2 * int(kv.k.nbytes // max(int(kv.k.shape[1]), 1)) + (1 << 20))
+        # The resume envelope is JSON only (original body + the relayed
+        # token ledger — never KV): a generous per-token byte budget over
+        # the model's max length plus slack bounds it.
+        self._resume_max_bytes = (
+            32 * int(engine.engine.config.effective_max_len) + (1 << 20))
+        # KV wire integrity (--no-integrity-checks to disable): every
+        # frame this replica ENCODES carries per-page checksums and every
+        # frame it DECODES is verified (pre-integrity peers rejected
+        # 426-style at receive seams, skew-attributed at pull seams). Off
+        # = byte-identical wire bytes, for mixed-fleet rollout and the
+        # bench A/B.
+        self.integrity_on = bool(integrity_checks)
+        # Peer reputation over the wire plane: corruptions/timeouts decay
+        # a peer's score; quarantined peers are skipped by every pull/
+        # spill/migration target walk for a backoff window (the first
+        # post-window attempt is the probe).
+        self.peer_scores = PeerScoreboard()
         # KV-pull allowlist: PREFILL_URL_HEADER reaches this replica from
         # the router (which strips client-supplied values), but a client
         # that can reach the pod DIRECTLY (per-pod DNS) could otherwise
@@ -295,6 +321,11 @@ class APIServer:
         # network boundary (dev/tests).
         self.prefill_pool = (frozenset(u.rstrip("/") for u in prefill_pool)
                              if prefill_pool else None)
+        # Quarantine metric labels come ONLY from the configured
+        # allowlists (bounded cardinality), seeded so idle peers render 0.
+        engine.engine.obs.seed_peers(self.peer_list)
+        if self.prefill_pool:
+            engine.engine.obs.seed_peers(sorted(self.prefill_pool))
         self._http: Optional[Any] = None   # lazy aiohttp.ClientSession
         self._profile_busy = False
         # Fleet-wide prefix cache (--fleet-prefix-cache): this replica
@@ -365,6 +396,67 @@ class APIServer:
         self.engine.engine.obs.flight.dump(
             "watchdog_trip", trips=self.watchdog.trips,
             timeout_s=self.watchdog.timeout_s)
+
+    def _wire_corruption(self, path: str, peer: Optional[str], rid: str,
+                         err: Exception) -> None:
+        """One integrity detection on a client/receive seam: counter,
+        trace span, flight-recorder evidence — and, when the peer is
+        known, a corruption-weight score decay. The transition INTO
+        quarantine is itself counted and dumped (the operator's "which
+        peer is lying about bytes" answer)."""
+        obs = self.engine.engine.obs
+        outcome = ("skew" if isinstance(err, ProtocolSkewError)
+                   else "corrupt")
+        obs.on_wire_corruption(path, outcome)
+        obs.tracer.emit("handoff", rid, side="integrity", path=path,
+                        outcome=outcome, peer=peer or "",
+                        error=str(err)[:200])
+        obs.flight.dump("wire_corruption", request_id=rid, path=path,
+                        outcome=outcome, peer=peer or "",
+                        error=str(err)[:200])
+        if peer and self.peer_scores.record_corruption(peer):
+            obs.on_peer_quarantine(peer)
+            obs.flight.dump("peer_quarantine", peer=peer, path=path,
+                            request_id=rid)
+            logger.warning("peer %s quarantined after wire corruption "
+                           "on %s", peer, path,
+                           extra={"request_id": rid})
+
+    def _peer_failure(self, peer: Optional[str]) -> None:
+        """A timeout/transport failure against ``peer``: lighter decay
+        than a corruption, same quarantine accounting on the crossing."""
+        if peer and self.peer_scores.record_timeout(peer):
+            obs = self.engine.engine.obs
+            obs.on_peer_quarantine(peer)
+            obs.flight.dump("peer_quarantine", peer=peer, path="timeout")
+            logger.warning("peer %s quarantined after repeated failures",
+                           peer)
+
+    def _chaos_stale(self, state: dict) -> tuple[dict, bool]:
+        """The ``peer_stale_frame`` chaos site (serve side): ``value`` 1
+        serves the pre-integrity wire dialect (drilling the receiver's
+        426-style skew rejection); any other value serves a frame whose
+        model header lies (the stale-peer drill — the receiver's model
+        check rejects it before any page can commit). Unarmed:
+        passthrough."""
+        val = _fault_value("peer_stale_frame")
+        if val is None:
+            return state, self.integrity_on
+        if int(val) == 1:
+            return state, False
+        stale = dict(state)
+        stale["model"] = str(state.get("model", "")) + "-stale"
+        return stale, self.integrity_on
+
+    @staticmethod
+    def _chaos_corrupt(blob):
+        """The ``kv_wire_corrupt`` chaos site (transit): flip one payload
+        byte of an already-encoded frame — exactly the bit-flip the
+        integrity layer exists to catch. Unarmed: passthrough."""
+        if _inject_fault("kv_wire_corrupt"):
+            blob = bytearray(blob)
+            blob[-1] ^= 0xFF
+        return blob
 
     def _on_import_fallback(self, rid: str = None) -> None:
         """Engine-side import failure (worker thread). A mid-stream resume
@@ -513,6 +605,14 @@ class APIServer:
         failed too -> sever the relay anyway and let the router's
         token-replay recompute rung carry the session."""
         obs = self.engine.engine.obs
+        peer = url.rstrip("/")
+        if self.peer_scores.quarantined(peer):
+            # Quarantined target: never export toward it — the sequence
+            # stays attached and rides the wait-it-out drain rung.
+            self.migration.on_migrate("push", "fallback", 0, 0.0)
+            obs.tracer.emit("migrate", rid, side="push", outcome="fallback",
+                            reason="quarantined", peer=peer)
+            return
         t0 = time.perf_counter()
         try:
             if _inject_fault("migrate_fail"):
@@ -533,7 +633,8 @@ class APIServer:
                            "out the decode", rid, e,
                            extra={"request_id": rid})
             return
-        blob = encode_handoff(state)
+        blob = bytes(self._chaos_corrupt(
+            encode_handoff(state, integrity=self.integrity_on)))
         try:
             # One push may spend at most half the drain budget: the
             # wait-it-out fallback (and a local re-import) must still fit
@@ -546,11 +647,14 @@ class APIServer:
             logger.warning("migration push of %s to %s failed (%s); "
                            "re-importing locally", rid, url, e,
                            extra={"request_id": rid})
+            self._peer_failure(peer)
             dt = time.perf_counter() - t0
             try:
                 # The export already retired the sequence — restore it
-                # from the snapshot (the same import a peer would run) so
-                # the client stream continues locally, wait-it-out style.
+                # from the snapshot (the same import a peer would run,
+                # integrity-stash verified the same way) so the client
+                # stream continues locally, wait-it-out style.
+                verify_import_state(state)
                 await self.engine.run_in_worker(
                     lambda eng: eng.import_request(rid, ids, params, state))
                 self.migration.on_migrate("push", "fallback", len(blob), dt)
@@ -567,6 +671,7 @@ class APIServer:
                 self.engine.post_exception(rid, StreamMigratedError(url))
             return
         dt = time.perf_counter() - t0
+        self.peer_scores.record_ok(peer)
         self.migration.on_migrate("push", "ok", len(blob), dt)
         obs.tracer.emit("migrate", rid, side="push", outcome="ok",
                         bytes=len(blob), ms=round(dt * 1e3, 2))
@@ -818,7 +923,8 @@ class APIServer:
             state = await self.engine.run_in_worker(
                 lambda e: e.export_held(rid))
             exported = True
-            payload = encode_handoff(state)
+            exp_state, integ = self._chaos_stale(state)
+            payload = encode_handoff(exp_state, integrity=integ)
         except ValueError as e:
             self.disagg.on_handoff("export", "error")
             return _error(400, str(e))
@@ -874,12 +980,32 @@ class APIServer:
             return _error(400, "migration push requires a valid "
                                f"{REQUEST_ID_HEADER}")
         t0 = time.perf_counter()
+        # Reject an oversized push on its declared length BEFORE
+        # buffering the body; the post-read check below still backstops
+        # chunked pushes that declare nothing.
+        if (request.content_length is not None
+                and request.content_length > self._handoff_max_bytes):
+            self.migration.on_migrate("recv", "error")
+            return _error(413, "migration blob exceeds the local KV bound")
         data = await request.read()
         if len(data) > self._handoff_max_bytes:
             self.migration.on_migrate("recv", "error")
             return _error(413, "migration blob exceeds the local KV bound")
         try:
-            state = decode_handoff(data)
+            state = decode_handoff(data,
+                                   require_integrity=self.integrity_on)
+        except ProtocolSkewError as e:
+            # Version-skew negotiation is LOUD: a pre-integrity pusher
+            # gets a clean upgrade-required rejection, not a decode
+            # attempt (it falls back to keeping the stream local).
+            self.migration.on_migrate("recv", "error")
+            self._wire_corruption("migrate", None, rid, e)
+            return _error(426, f"{e}; upgrade the peer or disable "
+                               "integrity checks fleet-wide")
+        except WireCorruptionError as e:
+            self.migration.on_migrate("recv", "error")
+            self._wire_corruption("migrate", None, rid, e)
+            return _error(400, f"bad migration blob: {e}")
         except ValueError as e:
             self.migration.on_migrate("recv", "error")
             return _error(400, f"bad migration blob: {e}")
@@ -941,6 +1067,11 @@ class APIServer:
         if self.drain_state.is_draining:
             return _overloaded(503, "server is draining; resume elsewhere",
                                1)
+        # The resume envelope carries JSON only (body + token ledger):
+        # reject an oversized one on its declared length BEFORE buffering.
+        if (request.content_length is not None
+                and request.content_length > self._resume_max_bytes):
+            return _error(413, "resume envelope exceeds the local bound")
         try:
             envelope = await request.json()
         except Exception:
@@ -993,6 +1124,17 @@ class APIServer:
                 obs.tracer.emit("migrate", rid, side="resume",
                                 outcome="stale_park",
                                 parked=len(po), relayed=len(relayed))
+                parked = None
+        if parked is not None:
+            # Import-seam verify: the parked pages sat in host memory
+            # since the push's decode — re-checksum against the frame's
+            # own integrity stash right before they can enter the pool
+            # (no-op for pre-integrity frames). A mismatch drops to token
+            # replay, the same recompute rung as a stale park.
+            try:
+                verify_import_state(parked)
+            except WireCorruptionError as e:
+                self._wire_corruption("resume", None, rid, e)
                 parked = None
         detok = IncrementalDetokenizer(self.tokenizer, stop=_stops(body))
         migrate_url = request.headers.get(MIGRATE_URL_HEADER)
@@ -1117,6 +1259,15 @@ class APIServer:
         evidence."""
         import aiohttp
         obs = self.engine.engine.obs
+        peer = prefill_url.rstrip("/")
+        if self.peer_scores.quarantined(peer):
+            # Quarantined peer: skip before the socket — local prefill
+            # serves it, byte-identical, while the backoff window runs.
+            self.disagg.on_handoff("import", "fallback", 0, 0.0)
+            obs.tracer.emit("handoff", rid, side="import",
+                            outcome="fallback", reason="quarantined",
+                            peer=peer)
+            return None
         t0 = time.perf_counter()
         try:
             if _inject_fault("kv_handoff_fail"):
@@ -1128,18 +1279,36 @@ class APIServer:
                 self._http, prefill_url, handoff_request_body(ids, body),
                 rid, self._handoff_max_bytes, timeout_s=HANDOFF_TIMEOUT_S,
                 qos_tier=tier)
-            state = decode_handoff(data)
+            data = self._chaos_corrupt(data)
+            state = decode_handoff(data,
+                                   require_integrity=self.integrity_on)
+            # Import-seam verify right before the state can reach the
+            # engine's import (pops the integrity stash either way).
+            verify_import_state(state)
+        except (WireCorruptionError, ProtocolSkewError) as e:
+            dt = time.perf_counter() - t0
+            logger.warning("kv handoff pull from %s failed integrity "
+                           "(%s); falling back to local prefill",
+                           prefill_url, e, extra={"request_id": rid})
+            self._wire_corruption("handoff", peer, rid, e)
+            self.disagg.on_handoff("import", "fallback", 0, dt)
+            obs.tracer.emit("handoff", rid, side="import",
+                            outcome="fallback", error=str(e)[:200],
+                            ms=round(dt * 1e3, 2))
+            return None
         except Exception as e:
             dt = time.perf_counter() - t0
             logger.warning("kv handoff pull from %s failed (%s); falling "
                            "back to local prefill", prefill_url, e,
                            extra={"request_id": rid})
+            self._peer_failure(peer)
             self.disagg.on_handoff("import", "fallback", 0, dt)
             obs.tracer.emit("handoff", rid, side="import",
                             outcome="fallback", error=str(e)[:200],
                             ms=round(dt * 1e3, 2))
             return None
         dt = time.perf_counter() - t0
+        self.peer_scores.record_ok(peer)
         self.disagg.on_handoff("import", "ok", len(data), dt)
         obs.tracer.emit("handoff", rid, side="import", outcome="ok",
                         bytes=len(data), ms=round(dt * 1e3, 2))
@@ -1174,13 +1343,16 @@ class APIServer:
             digest_hex, k_np, v_np = item
             frame = encode_spill_frame(
                 digest_hex, k_np, v_np, eng.model_config.name,
-                eng.config.cache.page_size)
+                eng.config.cache.page_size, integrity=self.integrity_on)
+            frame = self._chaos_corrupt(frame)
             if self._http is None:
                 self._http = aiohttp.ClientSession()
             outcome = "dropped"
             for _ in range(len(self.peer_list)):
                 url = self.peer_list[idx % len(self.peer_list)]
                 idx += 1
+                if self.peer_scores.quarantined(url):
+                    continue
                 try:
                     async with self._http.post(
                             f"{url}/internal/fleet_spill", data=frame,
@@ -1190,12 +1362,14 @@ class APIServer:
                         if resp.status == 200:
                             outcome = "ok"
                             await resp.read()
+                            self.peer_scores.record_ok(url)
                             break
                         await resp.read()
                 except asyncio.CancelledError:
                     raise
                 except Exception:
                     outcome = "error"
+                    self._peer_failure(url)
             eng.obs.on_fleet_spill(outcome,
                                    len(frame) if outcome == "ok" else 0)
             eng.obs.tracer.emit("fleet_prefix", "", side="spill",
@@ -1242,7 +1416,8 @@ class APIServer:
             REQUEST_ID_HEADER: rid})
         await resp.prepare(request)
         n_bytes = 0
-        for part in encode_prefix_frames(state):
+        exp_state, integ = self._chaos_stale(state)
+        for part in encode_prefix_frames(exp_state, integrity=integ):
             await resp.write(bytes(part))
             n_bytes += len(part)
         await resp.write_eof()
@@ -1261,9 +1436,28 @@ class APIServer:
         if not self.fleet_on:
             return _error(404, "fleet prefix cache is not enabled on this "
                                "replica")
+        # Bound the body BEFORE buffering: a peer page is at most one
+        # K|V page pair plus framing — anything larger is not a spill.
+        if (request.content_length is not None
+                and request.content_length > self._spill_max_bytes):
+            return _error(413, f"spill frame {request.content_length} bytes "
+                               f"exceeds the local bound "
+                               f"{self._spill_max_bytes}")
         data = await request.read()
+        if len(data) > self._spill_max_bytes:
+            return _error(413, f"spill frame {len(data)} bytes exceeds the "
+                               f"local bound {self._spill_max_bytes}")
+        rid = request.get("kgct_request_id") or ""
         try:
-            digest_hex, header, k_np, v_np = decode_spill_frame(data)
+            digest_hex, header, k_np, v_np = decode_spill_frame(
+                data, require_integrity=self.integrity_on)
+        except ProtocolSkewError as e:
+            self._wire_corruption("spill", None, rid, e)
+            return _error(426, f"{e}; upgrade the peer or disable "
+                               "integrity checks fleet-wide")
+        except WireCorruptionError as e:
+            self._wire_corruption("spill", None, rid, e)
+            return _error(400, f"bad spill frame: {e}")
         except ValueError as e:
             return _error(400, f"bad spill frame: {e}")
         if header.get("model") != self.engine.engine.model_config.name:
@@ -1315,12 +1509,21 @@ class APIServer:
                                 outcome="skipped", reason="roofline",
                                 tokens=remaining)
                 return
+            src = source_url.rstrip("/")
+            if self.peer_scores.quarantined(src):
+                # Owner sits in a quarantine window: never contact it —
+                # local recompute serves the prefix byte-identically.
+                obs.on_fleet_pull("recompute")
+                obs.tracer.emit("fleet_prefix", rid, side="import",
+                                outcome="recompute", reason="quarantined",
+                                peer=src)
+                return
             if self._http is None:
                 self._http = aiohttp.ClientSession()
-            dec = PrefixStreamDecoder()
+            dec = PrefixStreamDecoder(require_integrity=self.integrity_on)
             n_bytes = 0
             async with self._http.post(
-                    f"{source_url.rstrip('/')}/internal/fetch_prefix",
+                    f"{src}/internal/fetch_prefix",
                     json={"prompt_token_ids": list(ids),
                           "have_tokens": local},
                     headers={REQUEST_ID_HEADER: rid},
@@ -1337,7 +1540,7 @@ class APIServer:
                         raise RuntimeError(
                             f"prefix stream exceeds the local bound "
                             f"{self._handoff_max_bytes}")
-                    parts = dec.feed(chunk)
+                    parts = dec.feed(self._chaos_corrupt(chunk))
                     if handle is None and dec.header is not None:
                         hdr = dict(dec.header)
                         handle = await self.engine.run_in_worker(
@@ -1352,9 +1555,25 @@ class APIServer:
                 lambda e, h=handle: e.commit_prefix_import(h))
             handle = None
             dt = time.perf_counter() - t0
+            self.peer_scores.record_ok(src)
             obs.on_fleet_pull("ok", n_bytes, dt)
             obs.tracer.emit("fleet_prefix", rid, side="import",
                             outcome="ok", tokens=tokens, bytes=n_bytes,
+                            ms=round(dt * 1e3, 2))
+        except (WireCorruptionError, ProtocolSkewError) as e:
+            # Checksum/protocol detection: abort the import (pages freed,
+            # KGCT010 order), attribute the peer, recompute locally.
+            dt = time.perf_counter() - t0
+            if handle is not None:
+                self.engine.post_to_worker(
+                    lambda e2, h=handle: e2.abort_prefix_import(h))
+            logger.warning("fleet prefix pull from %s failed integrity "
+                           "(%s); local recompute serves it", source_url,
+                           e, extra={"request_id": rid})
+            self._wire_corruption("prefix", source_url.rstrip("/"), rid, e)
+            obs.on_fleet_pull("recompute", 0, dt)
+            obs.tracer.emit("fleet_prefix", rid, side="import",
+                            outcome="recompute", error=str(e)[:200],
                             ms=round(dt * 1e3, 2))
         except Exception as e:
             dt = time.perf_counter() - t0
@@ -1364,6 +1583,7 @@ class APIServer:
             logger.warning("fleet prefix pull from %s failed (%s); local "
                            "recompute serves it", source_url, e,
                            extra={"request_id": rid})
+            self._peer_failure(source_url.rstrip("/"))
             obs.on_fleet_pull("recompute", 0, dt)
             obs.tracer.emit("fleet_prefix", rid, side="import",
                             outcome="recompute", error=str(e)[:200],
@@ -1909,6 +2129,7 @@ def build_server(config: EngineConfig, tokenizer_path: Optional[str] = None,
                  prefill_pool: Optional[list] = None,
                  peer_pool: Optional[list] = None,
                  fleet_prefix_cache: bool = False,
+                 integrity_checks: bool = True,
                  draft_params=None) -> APIServer:
     tokenizer = load_tokenizer(tokenizer_path)
     engine = AsyncLLMEngine(config, params=params,
@@ -1917,7 +2138,8 @@ def build_server(config: EngineConfig, tokenizer_path: Optional[str] = None,
     return APIServer(engine, tokenizer, model_name or config.model.name,
                      resilience=config.resilience, role=role,
                      prefill_pool=prefill_pool, peer_pool=peer_pool,
-                     fleet_prefix_cache=fleet_prefix_cache)
+                     fleet_prefix_cache=fleet_prefix_cache,
+                     integrity_checks=integrity_checks)
 
 
 def main(argv: Optional[list[str]] = None) -> None:
@@ -2065,6 +2287,14 @@ def main(argv: Optional[list[str]] = None) -> None:
                    "remote-spill evicted prefix pages to --peer-pool "
                    "siblings' host tiers before dropping them. Requires "
                    "--enable-prefix-caching; off = byte-identical serving")
+    p.add_argument("--no-integrity-checks", action="store_true",
+                   help="disable the KV wire-plane integrity layer "
+                   "(per-page CRC32C-style checksums + whole-frame digest "
+                   "on every handoff/prefix/spill/migration frame, "
+                   "verified at every import seam; default ON). Off = "
+                   "wire bytes byte-identical to the pre-integrity "
+                   "encoders — only for talking to peers that do not "
+                   "speak the integrity dialect yet")
     p.add_argument("--drain-grace-s", type=float, default=None,
                    help="SIGTERM drain: max seconds to wait for in-flight "
                    "requests before exiting anyway (default 120). With "
@@ -2258,6 +2488,7 @@ def main(argv: Optional[list[str]] = None) -> None:
                                       if u.strip()]
                                      if args.peer_pool else None),
                           fleet_prefix_cache=args.fleet_prefix_cache,
+                          integrity_checks=not args.no_integrity_checks,
                           draft_params=draft_params)
     app = server.build_app()
 

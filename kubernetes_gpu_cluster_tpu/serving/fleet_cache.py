@@ -22,7 +22,13 @@ composes:
 - the BOUNDED spill queue: eviction runs on the engine worker thread and
   must never block on a socket, so the remote-spill hook only enqueues
   (drop-oldest beyond the cap) and an async serving task drains the queue
-  toward allowlisted peers (``--peer-pool``).
+  toward allowlisted peers (``--peer-pool``);
+- the PEER SCOREBOARD: per-peer reputation over the KV wire plane.
+  Corruptions and timeouts decay a health score; a peer that sinks below
+  the quarantine threshold is excluded from pulls/spills/migration
+  targets for a backoff window, after which the NEXT attempt is the probe
+  (success restores, another failure re-quarantines). The router keeps
+  its own scoreboard over the same class for the ``_pick`` walk.
 
 Everything here is engine-free and jax-free so tests pin the policy
 arithmetic and the queue bounds without building an engine.
@@ -32,6 +38,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from collections import deque
 from typing import Optional
 
@@ -53,6 +60,19 @@ DEFAULT_FLOPS = {"tpu": 80e12, "cpu": 5e9}
 # cap the OLDEST entry drops (newer evictions are warmer) — a burst of
 # eviction pressure must never balloon host memory with in-flight spills.
 SPILL_QUEUE_CAP = 32
+
+# Peer-reputation defaults. A single corruption quarantines immediately
+# (a checksum mismatch is never noise — either the wire or the peer is
+# lying about bytes); timeouts take a few in a row (transient congestion
+# is normal). Scores recover multiplicatively on success so one good
+# probe after the window restores full standing quickly but not
+# instantly.
+PEER_SCORE_START = 1.0
+PEER_CORRUPT_COST = 1.0
+PEER_TIMEOUT_COST = 0.3
+PEER_RECOVERY_GAIN = 0.5
+PEER_QUARANTINE_THRESHOLD = 0.25
+PEER_QUARANTINE_S = 30.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -155,3 +175,92 @@ class SpillQueue:
             return self._q.popleft()
         except IndexError:
             return None
+
+
+class PeerScoreboard:
+    """Per-peer reputation over the KV wire plane (pulls, spills,
+    migration pushes — and, with its own instance, the router's proxy
+    walk). Single-threaded by construction (every caller runs on one
+    event loop), clock-injectable so tests pin the window arithmetic.
+
+    Lifecycle of a misbehaving peer: failures decay its score
+    (corruption >> timeout); crossing ``threshold`` quarantines it for
+    ``quarantine_s`` — :meth:`quarantined` excludes it from every target
+    walk. Once the window lapses the peer is AUTOMATICALLY a probe
+    candidate again (still at its decayed score): one success recovers
+    the score toward healthy, one more failure re-quarantines for a
+    fresh window. No unbounded state: one entry per allowlisted peer."""
+
+    def __init__(self, threshold: float = PEER_QUARANTINE_THRESHOLD,
+                 corrupt_cost: float = PEER_CORRUPT_COST,
+                 timeout_cost: float = PEER_TIMEOUT_COST,
+                 recovery: float = PEER_RECOVERY_GAIN,
+                 quarantine_s: float = PEER_QUARANTINE_S,
+                 clock=None):
+        self.threshold = threshold
+        self.corrupt_cost = corrupt_cost
+        self.timeout_cost = timeout_cost
+        self.recovery = recovery
+        self.quarantine_s = quarantine_s
+        self._clock = clock if clock is not None else time.monotonic
+        self._score: dict[str, float] = {}
+        self._until: dict[str, float] = {}
+        # Total quarantine ENTRIES per peer (the metric counter): only
+        # the below-threshold transition increments, not every excluded
+        # attempt during a window.
+        self.quarantines: dict[str, int] = {}
+        # Ever-quarantined peers whose recovery has not been observed
+        # yet: lets callers trace the probe-recovery transition.
+        self._in_quarantine: set = set()
+
+    def score(self, peer: str) -> float:
+        return self._score.get(peer, PEER_SCORE_START)
+
+    def quarantined(self, peer: str) -> bool:
+        """True while ``peer`` sits inside an active backoff window —
+        excluded from pulls/spills/migration targets. The first attempt
+        AFTER the window is the probe: this returns False then, and the
+        attempt's outcome decides recovery vs re-quarantine."""
+        return self._clock() < self._until.get(peer, 0.0)
+
+    def retry_after_s(self, peer: str) -> float:
+        """Seconds left in the peer's backoff window (0 when none) — the
+        Retry-After a quarantine-derived 503 carries."""
+        return max(0.0, self._until.get(peer, 0.0) - self._clock())
+
+    def record_ok(self, peer: str) -> None:
+        """A successful exchange (probe included): recover the score
+        toward healthy and clear any lapsed window."""
+        s = min(PEER_SCORE_START,
+                self.score(peer) + self.recovery)
+        self._score[peer] = s
+        if peer in self._in_quarantine and s >= self.threshold:
+            self._in_quarantine.discard(peer)
+            self._until.pop(peer, None)
+
+    def record_timeout(self, peer: str) -> bool:
+        """One timeout/transport failure; True when this ENTERED
+        quarantine (the caller's cue to count/dump the transition)."""
+        return self._decay(peer, self.timeout_cost)
+
+    def record_corruption(self, peer: str) -> bool:
+        """One checksum/protocol detection; True when this ENTERED
+        quarantine."""
+        return self._decay(peer, self.corrupt_cost)
+
+    def _decay(self, peer: str, cost: float) -> bool:
+        """Apply one failure; True when this ENTERED quarantine (a
+        failure landing inside an already-active window extends it but
+        does not re-count — in-flight exchanges against a peer that just
+        crossed must not inflate the entry counter)."""
+        s = max(0.0, self.score(peer) - cost)
+        self._score[peer] = s
+        if s < self.threshold:
+            now = self._clock()
+            entered = now >= self._until.get(peer, 0.0)
+            if entered:
+                self.quarantines[peer] = self.quarantines.get(peer, 0) + 1
+            self._until[peer] = now + self.quarantine_s
+            self._in_quarantine.add(peer)
+            return entered
+        return False

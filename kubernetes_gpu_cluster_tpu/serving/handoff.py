@@ -20,6 +20,18 @@ api_server composes:
   byte-identical, just slower (chaos site ``kv_handoff_fail`` forces that
   path deterministically).
 
+Every codec here (handoff frame, prefix stream, spill frame) additionally
+speaks a versioned INTEGRITY extension: with ``integrity=True`` the JSON
+header carries per-page CRC32 checksums over the K|V slabs plus a
+whole-frame digest, verified on decode (incrementally, for the streamed
+prefix codec) and re-verified at the import seam by
+:func:`verify_import_state` right before the engine commit. A mismatch
+raises :class:`WireCorruptionError`; a pre-integrity peer talking to a
+receiver that requires checksums raises :class:`ProtocolSkewError` (both
+ValueError subclasses, so every degrade-to-recompute path is unchanged).
+Integrity OFF emits byte-identical pre-extension frames — mixed fleets
+interoperate during rollout and the checksum cost is a measurable A/B.
+
 Everything here is engine-free and jax-free so tests can pin the codec and
 the fetch discipline without building an engine.
 """
@@ -29,12 +41,109 @@ from __future__ import annotations
 import json
 import struct
 import time
+import zlib
 from typing import Optional
 
 import aiohttp
 import numpy as np
 
 from .errors import REQUEST_ID_HEADER
+
+
+class WireCorruptionError(ValueError):
+    """A frame whose bytes do not match its own declared checksums: a
+    bit-flip in transit, a truncation that still parses, or a peer that
+    serves stale pages under a fresh header. Subclasses ValueError so every
+    existing degrade-to-recompute catch handles it unchanged; callers that
+    care (metrics attribution, peer scoreboards) can still distinguish."""
+
+
+class ProtocolSkewError(ValueError):
+    """A peer speaking the pre-integrity wire dialect to a receiver that
+    requires checksums (or vice versa at a receive seam): the frames are not
+    worth a decode attempt — the negotiation failure is the finding. HTTP
+    seams translate this to a 426-style rejection instead of a decode."""
+
+
+def _page_crcs(arr) -> list:
+    """Per-page CRC32 of one KV array laid out ``[L, n_pages, ps, kd]``:
+    page ``p``'s checksum folds over every layer's contiguous ``[ps, kd]``
+    slab, exactly the bytes that land on the wire for that page whatever
+    codec (whole-frame or chunked) carried them. Byte-view fold — no
+    per-page temporaries, dtype-agnostic (bfloat16 included)."""
+    a = np.ascontiguousarray(arr)
+    b = a.view(np.uint8)
+    out = []
+    for p in range(a.shape[1]):
+        c = 0
+        for layer in range(a.shape[0]):
+            c = zlib.crc32(b[layer, p], c)
+        out.append(c)
+    return out
+
+
+def _frame_crc(k_crcs: list, v_crcs: list, payload_bytes: int) -> int:
+    """Whole-frame digest: CRC32 over the packed per-page checksum lists
+    plus the payload byte count. Covers the integrity metadata itself — a
+    header whose crc list was altered in transit fails here before any
+    per-page compare can be fooled."""
+    packed = struct.pack(f">{len(k_crcs)}I", *k_crcs) \
+        + struct.pack(f">{len(v_crcs)}I", *v_crcs) \
+        + struct.pack(">Q", payload_bytes)
+    return zlib.crc32(packed)
+
+
+def _check_integrity_header(header: dict, n_pages: int, payload_bytes: int,
+                            require: bool, what: str):
+    """Pop and validate the integrity fields of a decoded JSON header.
+    Returns ``(k_crcs, v_crcs)`` or ``None`` when the frame carries no
+    integrity fields (pre-integrity dialect) and ``require`` is False.
+    Raises :class:`ProtocolSkewError` when required-but-absent, and
+    :class:`WireCorruptionError` on a malformed or self-inconsistent
+    integrity header (wrong list lengths, frame digest mismatch)."""
+    pc = header.pop("page_crc", None)
+    fc = header.pop("frame_crc", None)
+    if pc is None or fc is None:
+        if require:
+            raise ProtocolSkewError(
+                f"{what}: peer speaks the pre-integrity wire dialect "
+                "(no page_crc/frame_crc header fields)")
+        return None
+    try:
+        k_crcs = [int(c) for c in pc["k"]]
+        v_crcs = [int(c) for c in pc["v"]]
+    except (TypeError, KeyError, ValueError):
+        raise WireCorruptionError(
+            f"{what}: malformed page_crc header") from None
+    if len(k_crcs) != n_pages or len(v_crcs) != n_pages:
+        raise WireCorruptionError(
+            f"{what}: page_crc lists cover {len(k_crcs)}/{len(v_crcs)} "
+            f"pages, frame carries {n_pages}")
+    if _frame_crc(k_crcs, v_crcs, payload_bytes) != int(fc):
+        raise WireCorruptionError(f"{what}: frame digest mismatch")
+    return k_crcs, v_crcs
+
+
+def verify_import_state(state: dict) -> None:
+    """The import-seam verify: re-checksum the K/V arrays of a decoded
+    state dict against the integrity stash its decode left behind
+    (``_integrity``), popping the stash either way so the engine's import
+    validation never sees it. Called immediately before every
+    ``import_request``-family commit — the last line of defense between a
+    frame that decoded clean and pages entering the pool. No-op for frames
+    that carried no integrity fields (integrity off / pre-integrity peer).
+    Raises :class:`WireCorruptionError` naming the first bad page."""
+    integ = state.pop("_integrity", None)
+    if integ is None:
+        return
+    for name in ("k", "v"):
+        want = integ[name]
+        got = _page_crcs(state[name])
+        if got != want:
+            bad = next(i for i, (g, w) in enumerate(zip(got, want))
+                       if g != w)
+            raise WireCorruptionError(
+                f"import state: {name} page {bad} checksum mismatch")
 
 # Frame: MAGIC + u32 header length + JSON header + k bytes + v bytes.
 HANDOFF_MAGIC = b"KGCT-KV1"
@@ -71,19 +180,29 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def encode_handoff(state: dict) -> bytearray:
+def encode_handoff(state: dict, integrity: bool = False) -> bytearray:
     """Engine export dict (``LLMEngine.export_held``) -> one binary frame.
 
     The arrays are copied straight into their slices of one preallocated
     buffer — no ``tobytes`` temporaries, no join copy — so a concurrent
     burst of exports peaks at the frames themselves, not ~3x the KV bytes
     (returns ``bytearray`` for that reason; every consumer — aiohttp
-    response body, ``decode_handoff`` — takes any bytes-like)."""
+    response body, ``decode_handoff`` — takes any bytes-like).
+
+    ``integrity`` stamps the versioned integrity extension into the header
+    (per-page CRC32 lists + whole-frame digest). Off = byte-identical to
+    the pre-integrity frame, so mixed fleets interoperate during a rollout
+    and the knob's cost is measurable as a pure A/B."""
     k, v = state["k"], state["v"]
     header = dict(state)
     header.pop("k")
     header.pop("v")
     header["k_shape"] = list(k.shape)
+    if integrity:
+        k_crcs, v_crcs = _page_crcs(k), _page_crcs(v)
+        header["page_crc"] = {"k": k_crcs, "v": v_crcs}
+        header["frame_crc"] = _frame_crc(k_crcs, v_crcs,
+                                         k.nbytes + v.nbytes)
     header_bytes = json.dumps(header).encode()
     off = len(HANDOFF_MAGIC) + 4 + len(header_bytes)
     out = bytearray(off + k.nbytes + v.nbytes)
@@ -97,10 +216,18 @@ def encode_handoff(state: dict) -> bytearray:
     return out
 
 
-def decode_handoff(data: bytes | bytearray) -> dict:
+def decode_handoff(data: bytes | bytearray,
+                   require_integrity: bool = False) -> dict:
     """Binary frame -> the engine import state dict. Raises ValueError on
     any structural mismatch (truncated frame, oversized header, byte-count
-    drift) — the caller treats that as a failed handoff and recomputes."""
+    drift) — the caller treats that as a failed handoff and recomputes.
+
+    Frames carrying the integrity extension are checksum-verified here
+    (frame digest, then every page of K and V) and the per-page list is
+    stashed under ``_integrity`` so :func:`verify_import_state` can
+    re-check at the import seam right before the engine commit.
+    ``require_integrity`` rejects pre-integrity frames with
+    :class:`ProtocolSkewError` instead of silently trusting them."""
     m = len(HANDOFF_MAGIC)
     if data[:m] != HANDOFF_MAGIC:
         raise ValueError("handoff blob: bad magic")
@@ -121,10 +248,23 @@ def decode_handoff(data: bytes | bytearray) -> dict:
     if len(data) != off + 2 * nbytes:
         raise ValueError(
             f"handoff blob: payload {len(data) - off} bytes != 2 x {nbytes}")
+    crcs = _check_integrity_header(header, int(shape[1]), 2 * nbytes,
+                                   require_integrity, "handoff blob")
     header["k"] = np.frombuffer(data, dtype, count=int(np.prod(shape)),
                                 offset=off).reshape(shape)
     header["v"] = np.frombuffer(data, dtype, count=int(np.prod(shape)),
                                 offset=off + nbytes).reshape(shape)
+    if crcs is not None:
+        k_crcs, v_crcs = crcs
+        for name, arr, want in (("k", header["k"], k_crcs),
+                                ("v", header["v"], v_crcs)):
+            got = _page_crcs(arr)
+            if got != want:
+                bad = next(i for i, (g, w) in enumerate(zip(got, want))
+                           if g != w)
+                raise WireCorruptionError(
+                    f"handoff blob: {name} page {bad} checksum mismatch")
+        header["_integrity"] = {"k": k_crcs, "v": v_crcs}
     return header
 
 
@@ -168,17 +308,25 @@ PREFIX_PULL_TIMEOUT_S = 30.0
 
 
 def encode_prefix_frames(state: dict,
-                         chunk_pages: int = PREFIX_CHUNK_PAGES):
+                         chunk_pages: int = PREFIX_CHUNK_PAGES,
+                         integrity: bool = False):
     """Engine export dict (``LLMEngine.export_prefix``) -> an iterator of
     wire slabs: the header first, then one contiguous ``[k|v]`` slab per
     page chunk. The exporter writes each slab straight to the response so
     the importer can start scattering before the tail pages even left the
-    owner's socket."""
+    owner's socket. ``integrity`` stamps the per-page CRC lists + frame
+    digest into the header so the decoder verifies each chunk as it
+    completes; off = byte-identical to the pre-integrity stream."""
     k, v = state["k"], state["v"]
     header = {key: val for key, val in state.items()
               if key not in ("k", "v")}
     header["k_shape"] = list(k.shape)
     header["chunk_pages"] = int(chunk_pages)
+    if integrity:
+        k_crcs, v_crcs = _page_crcs(k), _page_crcs(v)
+        header["page_crc"] = {"k": k_crcs, "v": v_crcs}
+        header["frame_crc"] = _frame_crc(k_crcs, v_crcs,
+                                         k.nbytes + v.nbytes)
     hb = json.dumps(header).encode()
     yield PREFIX_MAGIC + struct.pack(">I", len(hb)) + hb
     n = k.shape[1]
@@ -201,9 +349,17 @@ class PrefixStreamDecoder:
     ``header`` is available once the first feed crossed the header
     boundary; ``done`` once every advertised page was yielded. Raises
     ValueError on any structural mismatch (bad magic, oversized header,
-    trailing bytes) — the importer aborts and recomputes."""
+    trailing bytes) — the importer aborts and recomputes.
 
-    def __init__(self):
+    Integrity: a stream whose header carries the checksum extension is
+    verified INCREMENTALLY — each chunk's pages are checksummed the moment
+    the chunk completes, BEFORE the importer can scatter it, so a
+    corrupted tail chunk aborts with the head chunks the only pages to
+    free (:class:`WireCorruptionError` at the corrupt chunk).
+    ``require_integrity`` rejects pre-integrity streams with
+    :class:`ProtocolSkewError` at the header."""
+
+    def __init__(self, require_integrity: bool = False):
         # bytearray: += is amortized O(1). An immutable bytes buffer
         # would memcpy the whole accumulated slab on EVERY socket chunk —
         # quadratic in slab size, ruinous at real-model page geometry.
@@ -213,6 +369,8 @@ class PrefixStreamDecoder:
         self._dtype = None
         self._chunk_pages = 0
         self._yielded_pages = 0
+        self._require_integrity = require_integrity
+        self._crcs = None           # (k_crcs, v_crcs) when integrity on
 
     @property
     def done(self) -> bool:
@@ -253,6 +411,10 @@ class PrefixStreamDecoder:
             raise ValueError(f"prefix stream: bad k_shape {shape}")
         if self._chunk_pages < 1:
             raise ValueError("prefix stream: bad chunk_pages")
+        payload = 2 * int(np.prod(shape)) * dtype.itemsize
+        self._crcs = _check_integrity_header(
+            header, int(shape[1]), payload, self._require_integrity,
+            "prefix stream")
         self._shape = shape
         self._dtype = dtype
         self.header = header
@@ -283,6 +445,18 @@ class PrefixStreamDecoder:
                                count=c * per_page // self._dtype.itemsize,
                                offset=c * per_page
                                ).reshape(L, c, ps, kd)
+            if self._crcs is not None:
+                start = self._yielded_pages
+                for name, arr, want in (("k", ck, self._crcs[0]),
+                                        ("v", cv, self._crcs[1])):
+                    got = _page_crcs(arr)
+                    if got != want[start:start + c]:
+                        bad = start + next(
+                            i for i, (g, w) in enumerate(
+                                zip(got, want[start:start + c])) if g != w)
+                        raise WireCorruptionError(
+                            f"prefix stream: {name} page {bad} checksum "
+                            "mismatch")
             out.append((ck, cv))
             del self._buf[:slab]
             self._yielded_pages += c
@@ -293,8 +467,8 @@ class PrefixStreamDecoder:
 
 
 def encode_spill_frame(digest_hex: str, k_np: np.ndarray,
-                       v_np: np.ndarray, model: str, page_size: int
-                       ) -> bytes:
+                       v_np: np.ndarray, model: str, page_size: int,
+                       integrity: bool = False) -> bytes:
     """One remote-spilled page -> one prefix-stream frame (single chunk)
     whose header carries the chained digest instead of token ids — the
     receiver parks it in its HOST tier keyed by the digest
@@ -303,14 +477,17 @@ def encode_spill_frame(digest_hex: str, k_np: np.ndarray,
              "dtype": str(k_np.dtype), "digest": digest_hex,
              "k": k_np, "v": v_np}
     return b"".join(bytes(part) for part in
-                    encode_prefix_frames(state, chunk_pages=1))
+                    encode_prefix_frames(state, chunk_pages=1,
+                                         integrity=integrity))
 
 
-def decode_spill_frame(data: bytes) -> tuple[str, dict, np.ndarray,
-                                             np.ndarray]:
+def decode_spill_frame(data: bytes, require_integrity: bool = False
+                       ) -> tuple[str, dict, np.ndarray, np.ndarray]:
     """Inverse of :func:`encode_spill_frame`: (digest_hex, header, k, v).
-    Raises ValueError on any mismatch."""
-    dec = PrefixStreamDecoder()
+    Raises ValueError on any mismatch (checksum mismatches as
+    :class:`WireCorruptionError`, pre-integrity frames under
+    ``require_integrity`` as :class:`ProtocolSkewError`)."""
+    dec = PrefixStreamDecoder(require_integrity=require_integrity)
     chunks = dec.feed(data)
     if dec.header is None or not dec.done or len(chunks) != 1:
         raise ValueError("spill frame: truncated or multi-chunk")

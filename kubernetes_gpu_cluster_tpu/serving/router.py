@@ -108,6 +108,7 @@ from .errors import (MIGRATE_URL_HEADER, PREFILL_URL_HEADER,
                      REQUEST_ID_HEADER, RESUME_MODE_HEADER,
                      valid_request_id)
 from .errors import overloaded_error as _proxy_error
+from .fleet_cache import PeerScoreboard
 
 logger = get_logger("serving.router")
 
@@ -398,6 +399,18 @@ class Router:
             from ..config.qos import resolve_tier_name, tenant_key_of
             self._resolve_tier_name = resolve_tier_name
             self._tenant_key_of = tenant_key_of
+        # Peer reputation over the proxy walk (the router's own instance
+        # of the KV wire plane's scoreboard): repeated traffic failures
+        # decay a replica's score past the bench machinery's view; a
+        # quarantined replica leaves _pick/_prefix_source/_ring_successor
+        # until its window lapses, and the first healthy probe after the
+        # window is the recovery probe. Quarantine entries render as
+        # kgct_peer_quarantines_total{peer} — pre-seeded with every
+        # configured replica so the label set is bounded and a fresh
+        # scrape shows zeros.
+        self.peer_scores = PeerScoreboard()
+        for r in self.replicas + self.prefill_replicas:
+            self.peer_scores.quarantines.setdefault(r.url, 0)
         self._session: Optional[aiohttp.ClientSession] = None
         self._health_task: Optional[asyncio.Task] = None
 
@@ -508,6 +521,12 @@ class Router:
                 # stalling (the engine's own wedge detector is slower than
                 # ours) — sit out the cooldown before trusting it again.
                 return
+            if not self.peer_scores.quarantined(replica.url):
+                # Probe-based recovery: the first healthy probe AFTER a
+                # lapsed quarantine window restores the replica's score
+                # (inside the window this branch is unreachable for score
+                # purposes — quarantined() still gates the pick walk).
+                self.peer_scores.record_ok(replica.url)
             replica.consecutive_failures = 0
             if not replica.healthy:
                 logger.info("replica %s back in rotation", replica.url)
@@ -659,6 +678,17 @@ class Router:
                         fam["type"] = line
                 else:
                     fam["samples"].append(line)
+        # Peer quarantine entries: the router's OWN scoreboard (label set
+        # bounded to configured replicas, zeros from the first scrape)
+        # shares a family name with each engine's replica-side board — one
+        # TYPE line, all samples contiguous, scraped samples relabelled.
+        scraped_quar = families.pop("kgct_peer_quarantines_total", None)
+        lines.append("# TYPE kgct_peer_quarantines_total counter")
+        lines += [f'kgct_peer_quarantines_total{{peer="{peer}"}} '
+                  f"{self.peer_scores.quarantines[peer]}"
+                  for peer in sorted(self.peer_scores.quarantines)]
+        if scraped_quar is not None:
+            lines.extend(scraped_quar["samples"])
         for fam in families.values():
             if fam["type"] is not None:
                 lines.append(fam["type"])
@@ -776,6 +806,8 @@ class Router:
         ring = self.ring if ring is None else ring
         healthy = [r for r in replicas
                    if (r.healthy or include_unhealthy)
+                   and (include_unhealthy
+                        or not self.peer_scores.quarantined(r.url))
                    and (not exclude or r.url not in exclude)]
         self._pick_info = {"policy": self.routing_policy, "pick": "none"}
         if not healthy:
@@ -1066,7 +1098,8 @@ class Router:
             return None
         for r in self.replicas:
             if r.url == owner_url:
-                if r.healthy and time.monotonic() >= r.benched_until:
+                if (r.healthy and time.monotonic() >= r.benched_until
+                        and not self.peer_scores.quarantined(r.url)):
                     return owner_url
                 return None
         return None
@@ -1082,6 +1115,7 @@ class Router:
         for url in self.ring.walk(key):
             replica = byurl.get(url)
             if replica is not None and replica.healthy \
+                    and not self.peer_scores.quarantined(url) \
                     and url not in exclude:
                 return url
         return None
@@ -1345,9 +1379,27 @@ class Router:
                        extra={"request_id": rid})
         resp = _proxy_error(
             503, "no healthy replicas; retry shortly",
-            retry_after_s=max(int(self.health_interval_s), 1))
+            retry_after_s=self._retry_after_s())
         resp.headers[REQUEST_ID_HEADER] = rid
         return resp
+
+    def _retry_after_s(self) -> int:
+        """Retry-After for a no-healthy 503: the soonest instant any
+        replica can return to rotation — the minimum remaining
+        bench/quarantine window across the pool — so a well-behaved
+        client backs off exactly as long as the shed will last (the
+        PR-2 admission-shed contract). Replicas that are merely
+        probe-down fall back to the health interval."""
+        now = time.monotonic()
+        waits = []
+        for r in self.replicas:
+            wait = max(r.benched_until - now,
+                       self.peer_scores.retry_after_s(r.url))
+            # A merely probe-down replica (no active window) can return
+            # on the next health tick.
+            waits.append(wait if wait > 0 else self.health_interval_s)
+        soonest = min(waits) if waits else self.health_interval_s
+        return max(int(math.ceil(soonest)), 1)
 
     async def _failover_midstream(self, request: web.Request,
                                   resp: web.StreamResponse, rid: str,
@@ -1517,6 +1569,17 @@ class Router:
     def _count_failure(self, replica: Replica, err: Exception,
                        request_id: str = "") -> None:
         replica.consecutive_failures += 1
+        if self.peer_scores.record_timeout(replica.url):
+            # Quarantine ENTRY (repeat offender): counted once per window
+            # and black-boxed — the replica leaves the pick walk until
+            # the window lapses and a healthy probe recovers it.
+            logger.warning("replica %s quarantined for >= %.0fs "
+                           "(repeated failures: %s)", replica.url,
+                           self.peer_scores.quarantine_s, err,
+                           extra=({"request_id": request_id}
+                                  if request_id else None))
+            self.flight.dump("peer_quarantine", peer=replica.url,
+                             request_id=request_id, error=str(err)[:200])
         if replica.consecutive_failures >= self.fail_threshold:
             replica.healthy = False
             replica.benched_until = time.monotonic() + self.bench_cooldown_s

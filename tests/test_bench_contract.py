@@ -376,3 +376,25 @@ class TestFleetCachePhaseContract:
                      "KGCT_BENCH_FLEET_SHARED", "KGCT_FLEET_BW_GBPS",
                      "KGCT_FLEET_FLOPS"):
             assert knob in text
+
+
+class TestIntegrityHeadlineContract:
+    """kv_integrity_overhead_ratio (the fleet-cache phase's third arm)
+    rides the same bounded last-line contract: droppable, null when the
+    phase was skipped."""
+
+    def test_headline_parses_and_is_droppable(self):
+        results = _fake_results()
+        results[-1]["fleet_cache"] = {
+            "pull": {"warm_ttft_p50_ms": 17.2},
+            "pull_integrity_off": {"warm_ttft_p50_ms": 16.9},
+            "kv_integrity_overhead_ratio": 1.018,
+        }
+        out = bench.assemble_output(results, "cpu")
+        parsed = bench.parse_result_line(json.dumps(out) + "\n")
+        assert parsed["kv_integrity_overhead_ratio"] == 1.018
+        assert "kv_integrity_overhead_ratio" in bench._DROPPABLE_HEADLINE
+
+    def test_absent_phase_yields_null_headline(self):
+        out = bench.assemble_output(_fake_results(), "cpu")
+        assert out["kv_integrity_overhead_ratio"] is None

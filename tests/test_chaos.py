@@ -487,7 +487,10 @@ class TestResumeAndRecv:
                      "output_top_logprobs": [], "k": k, "v": k}
             if mid_stream:
                 state["mid_stream"] = True
-            return encode_handoff(state)
+            # Speak the current wire dialect: the receiver requires the
+            # integrity extension by default (a plain frame is a 426
+            # skew rejection before any semantic validation).
+            return encode_handoff(state, integrity=True)
 
         async def go():
             octet = {"Content-Type": "application/octet-stream",

@@ -931,3 +931,28 @@ def test_fleet_prefix_cache_knob():
     args = ms["qwen3-engine-deployment.yaml"][
         "spec"]["template"]["spec"]["containers"][0]["args"]
     assert "--fleet-prefix-cache" not in args
+
+
+def test_integrity_checks_knob():
+    """vllmConfig.integrityChecks: default ON renders nothing (wire
+    bytes byte-identical to the pre-integrity encoders only when
+    explicitly opted OUT); only the literal ``false`` renders
+    --no-integrity-checks."""
+    ms = render_values(copy.deepcopy(VALUES))
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--no-integrity-checks" not in args
+    on = copy.deepcopy(VALUES)
+    on["servingEngineSpec"]["modelSpec"][0]["vllmConfig"][
+        "integrityChecks"] = True
+    ms = render_values(on)
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--no-integrity-checks" not in args
+    off = copy.deepcopy(VALUES)
+    off["servingEngineSpec"]["modelSpec"][0]["vllmConfig"][
+        "integrityChecks"] = False
+    ms = render_values(off)
+    args = ms["qwen3-engine-deployment.yaml"][
+        "spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--no-integrity-checks" in args

@@ -1,0 +1,267 @@
+"""Fleet chaos soak (ROADMAP 7a): sustained traffic through the router
+while chaos churns the membership.
+
+@slow: three stub replicas behind the real Router take continuous
+streaming traffic while ``replica_kill_midstream`` severs live upstream
+sockets (every client stream must still end complete — the failover
+splice, zero dropped streams), ``replica_down`` cycles replicas out and
+back (ONLY the downed replica's ~K/N affinity keys remap, each to its
+ring successor, and every key comes home on recovery), and the
+traffic-failure seam drives full quarantine -> probe-recovery round-trips
+on the router's peer scoreboard without a single client-visible failure.
+Engine-free on purpose: the soak pins the CONTROL plane (routing, splice,
+reputation) — the KV-byte plane has its own two-server scenario in
+tests/test_wire_integrity.py.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from kubernetes_gpu_cluster_tpu.resilience.faults import configure_faults
+from kubernetes_gpu_cluster_tpu.serving.errors import (
+    REQUEST_ID_HEADER, RESUME_MODE_HEADER)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    configure_faults(None)
+    yield
+    configure_faults(None)
+
+
+TOKENS = [11, 22, 33, 44, 55, 66]
+FULL_TEXT = [f"t{i} " for i in range(len(TOKENS))]
+
+
+async def _soak_replica(chunk_gap_s=0.02):
+    """A survivable stub replica: streams one SSE frame per token (with
+    the kgct_token_ids ledger) and continues relayed streams on
+    /internal/resume. Returns (runner, url, served, resumes)."""
+    from aiohttp import web as aioweb
+
+    served, resumes = [], []
+
+    async def health(request):
+        return aioweb.json_response({"status": "ok"})
+
+    async def metrics(request):
+        return aioweb.Response(text="", content_type="text/plain")
+
+    def frame(i):
+        return (b"data: " + json.dumps(
+            {"choices": [{"text": f"t{i} "}],
+             "kgct_token_ids": [TOKENS[i]]}).encode() + b"\n\n")
+
+    async def completions(request):
+        served.append(await request.json())
+        resp = aioweb.StreamResponse(
+            headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        for i in range(len(TOKENS)):
+            await resp.write(frame(i))
+            # One TCP chunk per frame: the router's per-chunk chaos check
+            # (replica_kill_midstream counts relayed chunks) stays
+            # deterministic.
+            await asyncio.sleep(chunk_gap_s)
+        await resp.write(b"data: [DONE]\n\n")
+        return resp
+
+    async def resume(request):
+        envelope = await request.json()
+        resumes.append({"rid": request.headers.get(REQUEST_ID_HEADER),
+                        "envelope": envelope})
+        relayed = envelope["relayed_token_ids"]
+        resp = aioweb.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            RESUME_MODE_HEADER: "import"})
+        await resp.prepare(request)
+        for i in range(len(relayed), len(TOKENS)):
+            await resp.write(frame(i))
+        await resp.write(b"data: [DONE]\n\n")
+        return resp
+
+    app = aioweb.Application()
+    app.router.add_get("/health", health)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/internal/resume", resume)
+    runner = aioweb.AppRunner(app)
+    await runner.setup()
+    site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, f"http://127.0.0.1:{runner.addresses[0][1]}", \
+        served, resumes
+
+
+def _texts(body: bytes):
+    """(texts, done) of one client-received SSE byte stream."""
+    texts, done = [], False
+    for part in body.split(b"\n\n"):
+        for line in part.split(b"\n"):
+            if not line.startswith(b"data:"):
+                continue
+            payload = line[5:].strip()
+            if payload == b"[DONE]":
+                done = True
+            elif payload:
+                doc = json.loads(payload)
+                assert "error" not in doc, doc
+                texts.append(doc["choices"][0]["text"])
+    return texts, done
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestFleetChaosSoak:
+    def test_sustained_traffic_survives_membership_churn(self, monkeypatch,
+                                                         tmp_path):
+        from kubernetes_gpu_cluster_tpu.serving.router import Router
+        monkeypatch.setenv("KGCT_FLIGHT_DIR", str(tmp_path))
+        N = 3
+
+        async def scenario():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            stubs = [await _soak_replica() for _ in range(N)]
+            runners = [s[0] for s in stubs]
+            urls = [s[1] for s in stubs]
+            served = {urls[i]: stubs[i][2] for i in range(N)}
+            resumes = [s[3] for s in stubs]
+            router = Router(urls, health_interval_s=9999,
+                            fail_threshold=99,
+                            routing_policy="prefix-affinity")
+            client = TestClient(TestServer(router.build_app()))
+            await client.start_server()
+            streams = kills = 0
+            try:
+                async def stream(session):
+                    nonlocal streams
+                    streams += 1
+                    r = await client.post(
+                        "/v1/completions",
+                        json={"prompt": f"soak {session}",
+                              "session_id": session, "max_tokens": 6,
+                              "stream": True})
+                    assert r.status == 200
+                    texts, done = _texts(await r.read())
+                    # THE soak invariant: whatever chaos is armed, the
+                    # client sees one complete stream — never truncated,
+                    # never an error frame, ledger stripped.
+                    assert done and texts == FULL_TEXT, (session, texts)
+
+                # -- phase 1: mid-stream kills under sustained load ------
+                for rnd in range(9):
+                    if rnd % 3 == 0:
+                        configure_faults(
+                            "replica_kill_midstream:after=2,times=1")
+                        kills += 1
+                    for j in range(3):
+                        await stream(f"p1-{rnd}-{j}")
+                    configure_faults(None)
+                assert router.failovers_total["import"] == kills
+                assert router.failovers_total["failed"] == 0
+                # Each kill produced exactly one resume splice carrying
+                # the relayed prefix (2 chunks relayed before the sever).
+                all_resumes = [r for rs in resumes for r in rs]
+                assert len(all_resumes) == kills
+                assert all(r["envelope"]["relayed_token_ids"] == TOKENS[:2]
+                           for r in all_resumes)
+
+                # -- phase 2: replica_down churn, remap contract ---------
+                keys = [f"soak-key-{i}".encode() for i in range(30)]
+
+                def owners():
+                    return {k: router._pick(affinity_key=k).url
+                            for k in keys}
+
+                baseline = owners()
+                by_owner: dict = {}
+                for k, u in baseline.items():
+                    by_owner.setdefault(u, []).append(k)
+                for cycle in range(N):
+                    down_url = urls[cycle]
+                    before = {u: len(served[u]) for u in urls}
+                    configure_faults(f"replica_down:value={cycle}")
+                    for r in router.replicas:
+                        await router._check(r, startup=True)
+                    configure_faults(None)
+                    assert not router.replicas[cycle].healthy
+                    churned = owners()
+                    moved = {k for k in keys if churned[k] != baseline[k]}
+                    # ~K/N remap: exactly the downed replica's keys move,
+                    # each to ITS ring successor — never a reshuffle.
+                    assert moved == set(by_owner[down_url]), \
+                        f"cycle {cycle}: non-owned keys remapped"
+                    assert len(moved) <= 2 * len(keys) // N
+                    for k in moved:
+                        assert churned[k] == next(
+                            u for u in router.ring.walk(k) if u != down_url)
+                    # Traffic keeps flowing during the downtime; the dead
+                    # replica serves none of it.
+                    for j in range(3):
+                        await stream(f"p2-{cycle}-{j}")
+                    assert len(served[down_url]) == before[down_url]
+                    # Recovery: probes restore it, every key comes home.
+                    router.replicas[cycle].benched_until = 0.0
+                    for r in router.replicas:
+                        await router._check(r)
+                    assert router.replicas[cycle].healthy
+                    assert owners() == baseline, \
+                        f"cycle {cycle}: owners did not return"
+
+                # -- phase 3: quarantine -> probe recovery round-trips ---
+                victim = router.replicas[0]
+                for trip in (1, 2):
+                    # Three traffic failures through the proxy's failure-
+                    # accounting seam: timeout-weight decay crosses the
+                    # threshold on the third — one quarantine ENTRY.
+                    for _ in range(3):
+                        router._count_failure(
+                            victim, RuntimeError("soak: injected timeout"))
+                    assert router.peer_scores.quarantined(victim.url)
+                    assert (router.peer_scores.quarantines[victim.url]
+                            == trip)
+                    # Quarantined = out of the pick walk; a mid-window
+                    # healthy probe must NOT restore it early...
+                    await router._check(victim)
+                    assert router.peer_scores.quarantined(victim.url)
+                    picked = {router._pick(affinity_key=k).url
+                              for k in keys}
+                    assert victim.url not in picked
+                    # ...and the fleet absorbs its traffic unharmed.
+                    before = len(served[victim.url])
+                    for j in range(3):
+                        await stream(f"p3-{trip}-{j}")
+                    assert len(served[victim.url]) == before
+                    # The 503 Retry-After derivation sees the window.
+                    assert router._retry_after_s() >= 1
+                    # Window lapses -> the next healthy probe IS the
+                    # recovery probe: score restored, back in the walk.
+                    router.peer_scores._until[victim.url] = 0.0
+                    await router._check(victim)
+                    assert not router.peer_scores.quarantined(victim.url)
+                    assert (router.peer_scores.score(victim.url)
+                            >= router.peer_scores.threshold)
+                    assert owners() == baseline
+                # Round-trips are attributed: entry counter + flight dump.
+                rm = await client.get("/metrics")
+                text = await rm.text()
+                assert (f'kgct_peer_quarantines_total{{peer="{victim.url}"}}'
+                        f" 2") in text
+                quarantine_dumps = [e for e in
+                                    router.flight.export()["events"]
+                                    if e.get("kind") == "peer_quarantine"]
+                assert len(quarantine_dumps) == 2
+                # Zero dropped streams over the WHOLE soak, and the soak
+                # actually soaked (every stub replica served traffic).
+                assert streams == 9 * 3 + N * 3 + 2 * 3
+                assert all(len(served[u]) > 0 for u in urls)
+            finally:
+                configure_faults(None)
+                await client.close()
+                for runner in reversed(runners):
+                    await runner.cleanup()
+
+        asyncio.run(scenario())

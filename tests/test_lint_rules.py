@@ -1008,3 +1008,97 @@ class TestDraftStateBoundary:  # KGCT017
             def f(e):
                 kv = e.scheduler.spec_proposer.kv_cache
         """, "KGCT017", relpath="serving/api_server.py") == []
+
+
+class TestWireIntegrity:  # KGCT018
+    def test_unverified_commit_fires(self):
+        found = lint("""
+            async def fleet_import(self, handle):
+                await self.engine.run_in_worker(
+                    lambda e: e.commit_prefix_import(handle))
+        """, "KGCT018", relpath="serving/api_server.py")
+        assert len(found) == 1 and "checksum-verify" in found[0].message
+
+    def test_unverified_import_request_fires(self):
+        found = lint("""
+            async def restore(self, rid, ids, params, state):
+                await self.engine.run_in_worker(
+                    lambda e: e.import_request(rid, ids, params, state))
+        """, "KGCT018", relpath="serving/api_server.py")
+        assert len(found) == 1
+
+    def test_unverified_resume_import_fires(self):
+        found = lint("""
+            def resume(self, rid, ids, params, parked):
+                return self.engine.generate(rid, ids, params,
+                                            handoff=parked)
+        """, "KGCT018", relpath="serving/api_server.py")
+        assert len(found) == 1 and "generate" in found[0].message
+
+    def test_verify_in_same_function_silent(self):
+        assert lint("""
+            def resume(self, rid, ids, params, parked):
+                verify_import_state(parked)
+                return self.engine.generate(rid, ids, params,
+                                            handoff=parked)
+        """, "KGCT018", relpath="serving/api_server.py") == []
+
+    def test_verify_in_transitive_callee_silent(self):
+        """The reaching path follows intra-module helpers: the pull
+        helper's verifying decode covers the caller's commit."""
+        assert lint("""
+            async def _pull(self, url, rid):
+                data = await fetch(url)
+                state = decode_handoff(data, require_integrity=True)
+                return state
+
+            async def run(self, rid, ids, params, url):
+                handoff = await self._pull(url, rid)
+                return self.engine.generate(rid, ids, params,
+                                            handoff=handoff)
+        """, "KGCT018", relpath="serving/api_server.py") == []
+
+    def test_decoder_construction_counts_as_verify(self):
+        assert lint("""
+            async def _pull_prefix(self, resp, handle):
+                dec = PrefixStreamDecoder(require_integrity=True)
+                async for chunk in resp:
+                    dec.feed(chunk)
+                await self.engine.run_in_worker(
+                    lambda e: e.commit_prefix_import(handle))
+        """, "KGCT018", relpath="serving/api_server.py") == []
+
+    def test_handoff_none_generate_silent(self):
+        """The plain serve path (no wire state) is not a commit."""
+        assert lint("""
+            def run(self, rid, ids, params):
+                return self.engine.generate(rid, ids, params,
+                                            handoff=None)
+        """, "KGCT018", relpath="serving/api_server.py") == []
+
+    def test_raw_frombuffer_fires(self):
+        found = lint("""
+            import numpy as np
+
+            def decode(data):
+                return np.frombuffer(data, dtype=np.uint8)
+        """, "KGCT018", relpath="serving/api_server.py")
+        assert len(found) == 1 and "frombuffer" in found[0].message
+
+    def test_codec_and_worker_loop_exempt(self):
+        assert lint("""
+            import numpy as np
+
+            def decode(data):
+                return np.frombuffer(data, dtype=np.uint8)
+        """, "KGCT018", relpath="serving/handoff.py") == []
+        assert lint("""
+            def _drain_inbox(self, e, rid, ids, params, state):
+                e.import_request(rid, ids, params, state)
+        """, "KGCT018", relpath="serving/async_engine.py") == []
+
+    def test_outside_serving_silent(self):
+        assert lint("""
+            def commit(self, handle):
+                self.commit_prefix_import(handle)
+        """, "KGCT018", relpath="engine/engine.py") == []

@@ -1,0 +1,618 @@
+"""Trustworthy KV wire plane: end-to-end frame integrity + peer quarantine.
+
+Tier-1 keeps the CHEAP pins: engine-free codec pins (integrity off is
+byte-identical to the pre-integrity encoders; on, every seam detects a
+flipped byte / a pre-integrity peer), engine-free PeerScoreboard window
+arithmetic with an injected clock, an engine-free router Retry-After pin,
+and ONE two-server HTTP chaos scenario proving the acceptance contract:
+with ``kv_wire_corrupt`` injected on a fleet pull / handoff pull /
+migration push, the final client output is byte-identical to recompute
+(greedy AND seeded), the corruption is attributed in metrics + the flight
+recorder, and the offending peer is quarantined then recovers via probe.
+The sustained fleet soak lives in tests/test_fleet_soak.py (@slow).
+"""
+
+import asyncio
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from kubernetes_gpu_cluster_tpu.config import (
+    CacheConfig, EngineConfig, SchedulerConfig, get_model_config)
+from kubernetes_gpu_cluster_tpu.resilience.faults import configure_faults
+from kubernetes_gpu_cluster_tpu.serving.fleet_cache import (
+    PEER_QUARANTINE_S, PEER_QUARANTINE_THRESHOLD, PEER_SCORE_START,
+    PeerScoreboard)
+from kubernetes_gpu_cluster_tpu.serving.handoff import (
+    HANDOFF_MAGIC, PrefixStreamDecoder, ProtocolSkewError,
+    WireCorruptionError, decode_handoff, decode_spill_frame, encode_handoff,
+    encode_prefix_frames, encode_spill_frame, verify_import_state)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    configure_faults(None)
+    yield
+    configure_faults(None)
+
+
+def _engine_config():
+    return EngineConfig(
+        model=get_model_config("debug-tiny"),
+        cache=CacheConfig(page_size=16, num_pages=96, swap_space_gb=0.0),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_prefill_tokens=128,
+                                  decode_buckets=(1, 2),
+                                  prefill_buckets=(32, 64, 128),
+                                  decode_window=4, mixed_batch_enabled=False,
+                                  enable_prefix_caching=True))
+
+
+def _state(n_pages=5, dtype="float32", **extra):
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal((2, n_pages, 16, 64)).astype(dtype)
+    st = {"model": "debug-tiny", "page_size": 16, "dtype": dtype,
+          "matched_tokens": n_pages * 16,
+          "prompt_token_ids": list(range(n_pages * 16)),
+          "k": k, "v": k + 1}
+    st.update(extra)
+    return st
+
+
+def _header_of(blob: bytes) -> dict:
+    """Parse a handoff frame's JSON header without the codec (so the pins
+    below see the raw wire fields, pop-free)."""
+    m = len(HANDOFF_MAGIC)
+    (hlen,) = struct.unpack(">I", bytes(blob[m:m + 4]))
+    return json.loads(bytes(blob[m + 4:m + 4 + hlen]))
+
+
+class TestIntegrityCodec:
+    """Engine-free pins of the integrity extension (serving/handoff.py)."""
+
+    def test_integrity_off_is_pre_extension_wire_dialect(self):
+        """Off (the default) carries NO integrity fields — byte-level the
+        pre-integrity frame, so mixed fleets interoperate mid-rollout."""
+        st = _state()
+        blob = bytes(encode_handoff(st))
+        hdr = _header_of(blob)
+        assert "page_crc" not in hdr and "frame_crc" not in hdr
+        dec = decode_handoff(blob)
+        assert "_integrity" not in dec
+        verify_import_state(dec)  # no-op without the stash
+        assert np.array_equal(dec["k"], st["k"])
+        # And the prefix stream likewise.
+        part0 = next(iter(encode_prefix_frames(_state())))
+        phdr = json.loads(bytes(part0[12:]))
+        assert "page_crc" not in phdr and "frame_crc" not in phdr
+
+    def test_handoff_roundtrip_with_integrity(self):
+        st = _state()
+        blob = encode_handoff(st, integrity=True)
+        hdr = _header_of(blob)
+        assert len(hdr["page_crc"]["k"]) == 5 and "frame_crc" in hdr
+        dec = decode_handoff(blob)
+        assert np.array_equal(dec["k"], st["k"])
+        assert np.array_equal(dec["v"], st["v"])
+        # The decode leaves the stash for the import-seam re-check, which
+        # pops it (the engine's import validation never sees it).
+        assert "_integrity" in dec
+        verify_import_state(dec)
+        assert "_integrity" not in dec
+
+    def test_require_integrity_rejects_pre_integrity_frame(self):
+        blob = encode_handoff(_state())
+        with pytest.raises(ProtocolSkewError, match="pre-integrity"):
+            decode_handoff(blob, require_integrity=True)
+
+    def test_flipped_payload_byte_detected_and_named(self):
+        blob = bytearray(encode_handoff(_state(), integrity=True))
+        blob[-1] ^= 0xFF  # last byte = v payload, final page
+        with pytest.raises(WireCorruptionError,
+                           match=r"v page 4 checksum mismatch"):
+            decode_handoff(blob)
+
+    def test_tampered_crc_list_fails_frame_digest(self):
+        """The frame digest covers the checksum metadata itself: altering
+        a page_crc entry (without recomputing the digest) is caught before
+        any per-page compare could be fooled."""
+        st = _state()
+        blob = bytes(encode_handoff(st, integrity=True))
+        hdr = _header_of(blob)
+        hdr["page_crc"]["k"][0] ^= 1
+        hb = json.dumps(hdr).encode()
+        m = len(HANDOFF_MAGIC)
+        (hlen,) = struct.unpack(">I", blob[m:m + 4])
+        forged = (HANDOFF_MAGIC + struct.pack(">I", len(hb)) + hb
+                  + blob[m + 4 + hlen:])
+        with pytest.raises(WireCorruptionError,
+                           match="frame digest mismatch"):
+            decode_handoff(forged)
+
+    def test_import_seam_recheck_catches_post_decode_rot(self):
+        dec = decode_handoff(encode_handoff(_state(), integrity=True))
+        dec["k"][0, 2, 0, 0] += 1.0  # bit-rot while parked host-side
+        with pytest.raises(WireCorruptionError,
+                           match="k page 2 checksum mismatch"):
+            verify_import_state(dec)
+
+    def test_prefix_stream_verifies_incrementally(self):
+        """A flipped byte in chunk N raises when THAT chunk completes —
+        the importer aborts mid-stream, before the tail even arrives."""
+        st = _state()
+        parts = [bytearray(p) for p in
+                 encode_prefix_frames(st, chunk_pages=2, integrity=True)]
+        assert len(parts) == 4  # header + 3 slabs (2+2+1 pages)
+        parts[1][10] ^= 0xFF  # first slab -> pages 0-1
+        dec = PrefixStreamDecoder()
+        dec.feed(bytes(parts[0]))
+        with pytest.raises(WireCorruptionError, match="page [01]"):
+            dec.feed(bytes(parts[1]))
+
+    def test_prefix_stream_clean_roundtrip_with_integrity(self):
+        st = _state()
+        blob = b"".join(bytes(p) for p in
+                        encode_prefix_frames(st, chunk_pages=2,
+                                             integrity=True))
+        dec = PrefixStreamDecoder(require_integrity=True)
+        got = []
+        for i in range(0, len(blob), 1000):
+            got.extend(dec.feed(blob[i:i + 1000]))
+        assert dec.done
+        k = np.concatenate([ck for ck, _ in got], axis=1)
+        assert np.array_equal(k, st["k"])
+
+    def test_prefix_stream_skew_raises_at_header(self):
+        parts = list(encode_prefix_frames(_state(), chunk_pages=2))
+        with pytest.raises(ProtocolSkewError, match="pre-integrity"):
+            PrefixStreamDecoder(require_integrity=True).feed(
+                bytes(parts[0]))
+
+    def test_spill_frame_roundtrip_corrupt_and_skew(self):
+        rng = np.random.default_rng(1)
+        k = rng.standard_normal((2, 1, 16, 64)).astype("float32")
+        frame = encode_spill_frame("ab" * 32, k, k + 1, "debug-tiny", 16,
+                                   integrity=True)
+        digest, header, gk, gv = decode_spill_frame(
+            frame, require_integrity=True)
+        assert digest == "ab" * 32 and np.array_equal(gk, k)
+        bad = bytearray(frame)
+        bad[-1] ^= 0xFF
+        with pytest.raises(WireCorruptionError, match="checksum mismatch"):
+            decode_spill_frame(bytes(bad))
+        plain = encode_spill_frame("ab" * 32, k, k + 1, "debug-tiny", 16)
+        with pytest.raises(ProtocolSkewError):
+            decode_spill_frame(plain, require_integrity=True)
+
+    def test_bfloat16_pages_checksum_cleanly(self):
+        """The byte-view CRC fold must not trip over dtypes numpy alone
+        cannot hash/compare (the real KV dtype on accelerators)."""
+        import ml_dtypes
+        st = _state(dtype="float32")
+        st["k"] = st["k"].astype(ml_dtypes.bfloat16)
+        st["v"] = st["v"].astype(ml_dtypes.bfloat16)
+        st["dtype"] = "bfloat16"
+        dec = decode_handoff(encode_handoff(st, integrity=True))
+        verify_import_state(dec)
+        assert np.array_equal(dec["k"], st["k"])
+
+
+class TestPeerScoreboard:
+    """Engine-free pins of the reputation/quarantine window arithmetic
+    (clock injected — no sleeps, no wall-clock flake)."""
+
+    def _board(self):
+        t = [0.0]
+        sb = PeerScoreboard(clock=lambda: t[0])
+        return sb, t
+
+    def test_corruption_quarantines_instantly(self):
+        sb, _ = self._board()
+        assert sb.score("p") == PEER_SCORE_START
+        assert sb.record_corruption("p") is True  # the ENTRY transition
+        assert sb.quarantined("p") and sb.quarantines == {"p": 1}
+        assert sb.retry_after_s("p") == pytest.approx(PEER_QUARANTINE_S)
+
+    def test_timeouts_take_three(self):
+        sb, _ = self._board()
+        assert not sb.record_timeout("p") and not sb.quarantined("p")
+        assert not sb.record_timeout("p") and not sb.quarantined("p")
+        assert sb.record_timeout("p") is True
+        assert sb.quarantined("p")
+        assert sb.score("p") < PEER_QUARANTINE_THRESHOLD
+
+    def test_window_extension_does_not_recount(self):
+        sb, t = self._board()
+        assert sb.record_corruption("p")
+        t[0] = 10.0
+        # An in-flight exchange failing INSIDE the window extends it but
+        # is not a second quarantine entry (the metric counts entries).
+        assert sb.record_corruption("p") is False
+        assert sb.quarantines == {"p": 1}
+        assert sb.retry_after_s("p") == pytest.approx(PEER_QUARANTINE_S)
+
+    def test_window_decays_and_probe_recovers(self):
+        sb, t = self._board()
+        sb.record_corruption("p")
+        t[0] = PEER_QUARANTINE_S / 2
+        assert sb.retry_after_s("p") == pytest.approx(PEER_QUARANTINE_S / 2)
+        t[0] = PEER_QUARANTINE_S + 1
+        # Window lapsed: the peer is a probe candidate again...
+        assert not sb.quarantined("p") and sb.retry_after_s("p") == 0.0
+        # ...and one successful probe recovers it past the threshold.
+        sb.record_ok("p")
+        assert sb.score("p") >= PEER_QUARANTINE_THRESHOLD
+        assert not sb.quarantined("p")
+        # A LATER corruption is a fresh entry (counter hits 2).
+        assert sb.record_corruption("p") is True
+        assert sb.quarantines == {"p": 2}
+
+    def test_refailure_after_lapse_recounts(self):
+        sb, t = self._board()
+        sb.record_corruption("p")
+        t[0] = PEER_QUARANTINE_S + 1
+        # Probe FAILS (score still on the floor): fresh window, fresh entry.
+        assert sb.record_corruption("p") is True
+        assert sb.quarantines == {"p": 2} and sb.quarantined("p")
+
+    def test_score_recovery_is_capped(self):
+        sb, _ = self._board()
+        sb.record_timeout("p")
+        for _ in range(5):
+            sb.record_ok("p")
+        assert sb.score("p") == PEER_SCORE_START
+
+
+class TestRouterQuarantine:
+    """Engine-free: the router's scoreboard feeds _pick exclusion and the
+    503 Retry-After derivation (the PR-2 admission-shed contract)."""
+
+    def _router(self):
+        from kubernetes_gpu_cluster_tpu.serving.router import Router
+        return Router(["http://a:1", "http://b:2"], health_interval_s=5.0)
+
+    def test_pick_excludes_quarantined_until_desperation(self):
+        r = self._router()
+        r.peer_scores.record_corruption("http://a:1")
+        for _ in range(4):
+            assert r._pick().url == "http://b:2"
+        # Desperation rounds (include_unhealthy) still see it: the router
+        # degrades, it never refuses while a replica exists.
+        urls = {r._pick(include_unhealthy=True).url for _ in range(8)}
+        assert "http://a:1" in urls
+
+    def test_retry_after_reflects_soonest_return(self):
+        r = self._router()
+        # One healthy replica: the soonest return is the next health tick.
+        assert r._retry_after_s() == 5
+        # Both quarantined: the soonest return is the shortest window.
+        r.peer_scores.record_corruption("http://a:1")
+        r.peer_scores.record_corruption("http://b:2")
+        assert 1 <= r._retry_after_s() <= int(PEER_QUARANTINE_S) + 1
+        assert r._retry_after_s() > 5
+
+    def test_quarantine_counter_preseeded_in_metrics(self):
+        r = self._router()
+        text = asyncio.run(r.metrics(None)).text
+        assert 'kgct_peer_quarantines_total{peer="http://a:1"} 0' in text
+        r.peer_scores.record_corruption("http://a:1")
+        assert ('kgct_peer_quarantines_total{peer="http://a:1"} 1'
+                in asyncio.run(r.metrics(None)).text)
+
+
+class TestWireChaosHTTP:
+    """ONE two-server scenario over real sockets: the acceptance contract.
+    kv_wire_corrupt on a fleet pull (greedy AND seeded), a handoff pull,
+    and a migration push — every time the client output is byte-identical
+    to recompute, the corruption is attributed (metrics + flight), the
+    peer is quarantined and recovers via probe. Plus the receive-seam
+    rejections: 426 protocol skew, 400 corrupt frame, 413 oversized
+    bodies (spill + resume) before buffering."""
+
+    def test_corrupt_quarantine_recover_and_receive_seams(self):
+        from aiohttp import web as aioweb
+
+        import aiohttp
+        from kubernetes_gpu_cluster_tpu.serving.api_server import build_server
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            PREFILL_URL_HEADER, PREFIX_SOURCE_HEADER, REQUEST_ID_HEADER)
+
+        async def scenario():
+            runners = []
+
+            async def serve(**kw):
+                srv = build_server(_engine_config(), None, "debug-tiny",
+                                   **kw)
+                runner = aioweb.AppRunner(srv.build_app())
+                await runner.setup()
+                site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                runners.append(runner)
+                return srv, f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+            def prompt(seed):
+                return np.random.default_rng(seed).integers(
+                    1, 200, 80).tolist()
+
+            try:
+                sa, ua = await serve(fleet_prefix_cache=True)
+                sb, ub = await serve(fleet_prefix_cache=True,
+                                     peer_pool=[ua], prefill_pool=[ua])
+                assert sa.integrity_on and sb.integrity_on
+                obs = sb.engine.engine.obs
+                pulls = obs.fleet_pulls
+                async with aiohttp.ClientSession() as sess:
+                    async def comp(base, js, headers=None):
+                        async with sess.post(f"{base}/v1/completions",
+                                             json=js,
+                                             headers=headers or {}) as resp:
+                            assert resp.status == 200, await resp.text()
+                            return (await resp.json())[
+                                "choices"][0]["text"]
+
+                    def probe_peer():
+                        """Force the quarantine window to lapse (the
+                        probe transition) without sleeping 30s."""
+                        assert sb.peer_scores.quarantined(ua)
+                        sb.peer_scores._until[ua] = 0.0
+                        assert not sb.peer_scores.quarantined(ua)
+
+                    # -- fleet pull corrupted in transit (greedy) --------
+                    b1 = {"prompt": prompt(7), "max_tokens": 6,
+                          "temperature": 0.0}
+                    ref1 = await comp(ua, b1)
+                    configure_faults("kv_wire_corrupt:times=1")
+                    got1 = await comp(ub, b1,
+                                      headers={PREFIX_SOURCE_HEADER: ua})
+                    configure_faults(None)
+                    assert got1 == ref1          # byte-identical recompute
+                    assert pulls["recompute"] == 1 and pulls["ok"] == 0
+                    # Attribution: counter, trace ring, flight recorder.
+                    assert obs.wire_corruptions[("prefix", "corrupt")] == 1
+                    flight = obs.flight.export()["events"]
+                    assert any(e.get("kind") == "wire_corruption"
+                               and e.get("path") == "prefix"
+                               and e.get("peer") == ua for e in flight)
+                    assert any(e.get("kind") == "peer_quarantine"
+                               and e.get("peer") == ua for e in flight)
+                    # The offender is quarantined: the next pull never
+                    # touches the socket, recompute serves it.
+                    assert sb.peer_scores.quarantined(ua)
+                    b2 = {"prompt": prompt(8), "max_tokens": 6,
+                          "temperature": 0.0}
+                    ref2 = await comp(ua, b2)
+                    got2 = await comp(ub, b2,
+                                      headers={PREFIX_SOURCE_HEADER: ua})
+                    assert got2 == ref2 and pulls["recompute"] == 2
+                    assert any(e.args.get("reason") == "quarantined"
+                               for e in obs.tracer.events()
+                               if e.kind == "fleet_prefix")
+
+                    # -- probe recovery: window lapses, one clean pull ---
+                    probe_peer()
+                    b3 = {"prompt": prompt(9), "max_tokens": 6,
+                          "temperature": 0.0}
+                    ref3 = await comp(ua, b3)
+                    got3 = await comp(ub, b3,
+                                      headers={PREFIX_SOURCE_HEADER: ua})
+                    assert got3 == ref3 and pulls["ok"] == 1
+                    assert (sb.peer_scores.score(ua)
+                            >= PEER_QUARANTINE_THRESHOLD)
+                    assert not sb.peer_scores.quarantined(ua)
+                    assert sb.peer_scores.quarantines[ua] == 1
+
+                    # -- fleet pull corrupted in transit (seeded) --------
+                    b4 = {"prompt": prompt(10), "max_tokens": 6,
+                          "temperature": 0.8, "seed": 11}
+                    ref4 = await comp(ua, b4)
+                    configure_faults("kv_wire_corrupt:times=1")
+                    got4 = await comp(ub, b4,
+                                      headers={PREFIX_SOURCE_HEADER: ua})
+                    configure_faults(None)
+                    assert got4 == ref4
+                    assert obs.wire_corruptions[("prefix", "corrupt")] == 2
+                    assert sb.peer_scores.quarantines[ua] == 2
+                    probe_peer()
+                    b5 = {"prompt": prompt(11), "max_tokens": 6,
+                          "temperature": 0.0}
+                    await comp(ua, b5)
+                    await comp(ub, b5, headers={PREFIX_SOURCE_HEADER: ua})
+                    assert pulls["ok"] == 2  # recovered again
+
+                    # -- disaggregated handoff pull corrupted ------------
+                    b6 = {"prompt": prompt(12), "max_tokens": 6,
+                          "temperature": 0.0}
+                    ref6 = await comp(ua, b6)
+                    configure_faults("kv_wire_corrupt:times=1")
+                    got6 = await comp(ub, b6,
+                                      headers={PREFILL_URL_HEADER: ua})
+                    configure_faults(None)
+                    assert got6 == ref6          # local-prefill fallback
+                    assert obs.wire_corruptions[("handoff", "corrupt")] == 1
+                    assert sb.peer_scores.quarantines[ua] == 3
+                    hand = [e for e in obs.tracer.events()
+                            if e.kind == "handoff"
+                            and e.args.get("side") == "integrity"]
+                    assert any(e.args.get("path") == "handoff"
+                               and e.args.get("peer") == ua for e in hand)
+
+                    # -- stale-peer drill: exporter serves the
+                    #    pre-integrity dialect, importer rejects loudly --
+                    probe_peer()
+                    sb.peer_scores.record_ok(ua)
+                    b7 = {"prompt": prompt(13), "max_tokens": 6,
+                          "temperature": 0.0}
+                    ref7 = await comp(ua, b7)
+                    configure_faults("peer_stale_frame:value=1,times=1")
+                    got7 = await comp(ub, b7,
+                                      headers={PREFIX_SOURCE_HEADER: ua})
+                    configure_faults(None)
+                    assert got7 == ref7
+                    assert obs.wire_corruptions[("prefix", "skew")] == 1
+                    # A skew detection carries corruption weight too: the
+                    # stale peer is quarantined (4th entry).
+                    assert sb.peer_scores.quarantines[ua] == 4
+
+                    # -- migration push receive: corrupt -> 400, skew ->
+                    #    426, both attributed on the RECEIVER ------------
+                    mig = _state(mid_stream=True, output_token_ids=[1, 2])
+                    blob = bytearray(encode_handoff(mig, integrity=True))
+                    blob[-1] ^= 0xFF
+                    hdr = {"Content-Type": "application/octet-stream",
+                           REQUEST_ID_HEADER: "mig-corrupt-1"}
+                    async with sess.post(f"{ub}/internal/kv_handoff",
+                                         data=bytes(blob),
+                                         headers=hdr) as resp:
+                        assert resp.status == 400
+                        assert "bad migration blob" in await resp.text()
+                    assert obs.wire_corruptions[("migrate", "corrupt")] == 1
+                    plain = bytes(encode_handoff(mig))  # pre-integrity
+                    async with sess.post(f"{ub}/internal/kv_handoff",
+                                         data=plain,
+                                         headers=dict(
+                                             hdr, **{REQUEST_ID_HEADER:
+                                                     "mig-skew-1"})
+                                         ) as resp:
+                        assert resp.status == 426
+                        assert "upgrade the peer" in await resp.text()
+                    assert obs.wire_corruptions[("migrate", "skew")] == 1
+
+                    # -- spill receive: skew 426, corrupt 400, oversized
+                    #    413 BEFORE buffering ---------------------------
+                    rng = np.random.default_rng(2)
+                    pk = rng.standard_normal((2, 1, 16, 64)).astype(
+                        "float32")
+                    shdr = {"Content-Type": "application/octet-stream"}
+                    plain_spill = encode_spill_frame(
+                        "cd" * 32, pk, pk + 1, "debug-tiny", 16)
+                    async with sess.post(f"{ub}/internal/fleet_spill",
+                                         data=plain_spill,
+                                         headers=shdr) as resp:
+                        assert resp.status == 426
+                    bad_spill = bytearray(encode_spill_frame(
+                        "cd" * 32, pk, pk + 1, "debug-tiny", 16,
+                        integrity=True))
+                    bad_spill[-1] ^= 0xFF
+                    async with sess.post(f"{ub}/internal/fleet_spill",
+                                         data=bytes(bad_spill),
+                                         headers=shdr) as resp:
+                        assert resp.status == 400
+                        assert "bad spill frame" in await resp.text()
+                    assert obs.wire_corruptions[("spill", "skew")] == 1
+                    assert obs.wire_corruptions[("spill", "corrupt")] == 1
+                    async with sess.post(
+                            f"{ub}/internal/fleet_spill",
+                            data=b"\0" * (sb._spill_max_bytes + 1),
+                            headers=shdr) as resp:
+                        assert resp.status == 413
+                    async with sess.post(
+                            f"{ub}/internal/resume",
+                            data=b"\0" * (sb._resume_max_bytes + 1),
+                            headers={REQUEST_ID_HEADER: "resume-big-1"}
+                            ) as resp:
+                        assert resp.status == 413
+
+                    # -- /metrics renders every series, seeded zeros
+                    #    included ---------------------------------------
+                    async with sess.get(f"{ub}/metrics") as resp:
+                        text = await resp.text()
+                    assert ('kgct_kv_wire_corruptions_total'
+                            '{path="prefix",outcome="corrupt"} 2') in text
+                    assert ('kgct_kv_wire_corruptions_total'
+                            '{path="handoff",outcome="corrupt"} 1') in text
+                    assert ('kgct_kv_wire_corruptions_total'
+                            '{path="migrate",outcome="corrupt"} 1') in text
+                    assert ('kgct_kv_wire_corruptions_total'
+                            '{path="migrate",outcome="skew"} 1') in text
+                    assert ('kgct_kv_wire_corruptions_total'
+                            '{path="spill",outcome="corrupt"} 1') in text
+                    assert ('kgct_kv_wire_corruptions_total'
+                            '{path="spill",outcome="skew"} 1') in text
+                    assert ('kgct_kv_wire_corruptions_total'
+                            '{path="resume",outcome="corrupt"} 0') in text
+                    assert (f'kgct_peer_quarantines_total{{peer="{ua}"}} 4'
+                            in text)
+                    # The owner never saw a corruption: all zeros there.
+                    async with sess.get(f"{ua}/metrics") as resp:
+                        atext = await resp.text()
+                    assert ('kgct_kv_wire_corruptions_total'
+                            '{path="prefix",outcome="corrupt"} 0') in atext
+            finally:
+                for runner in reversed(runners):
+                    await runner.cleanup()
+
+        asyncio.run(scenario())
+
+
+class TestIntegrityOffByteIdentical:
+    """integrity_checks=False: the wire bytes are byte-identical to the
+    pre-integrity encoders END TO END (server-level half of the rollout
+    contract; the codec-level half is TestIntegrityCodec)."""
+
+    def test_off_serves_pre_integrity_frames_and_interops(self):
+        from aiohttp import web as aioweb
+
+        import aiohttp
+        from kubernetes_gpu_cluster_tpu.serving.api_server import build_server
+        from kubernetes_gpu_cluster_tpu.serving.errors import (
+            PREFIX_SOURCE_HEADER)
+
+        async def scenario():
+            runners = []
+
+            async def serve(**kw):
+                srv = build_server(_engine_config(), None, "debug-tiny",
+                                   **kw)
+                runner = aioweb.AppRunner(srv.build_app())
+                await runner.setup()
+                site = aioweb.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                runners.append(runner)
+                return srv, f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+            try:
+                sa, ua = await serve(fleet_prefix_cache=True,
+                                     integrity_checks=False)
+                sb, ub = await serve(fleet_prefix_cache=True,
+                                     peer_pool=[ua],
+                                     integrity_checks=False)
+                assert not sa.integrity_on and not sb.integrity_on
+                prompt = np.random.default_rng(21).integers(
+                    1, 200, 80).tolist()
+                body = {"prompt": prompt, "max_tokens": 6,
+                        "temperature": 0.0}
+                async with aiohttp.ClientSession() as sess:
+                    async def comp(base, js, headers=None):
+                        async with sess.post(f"{base}/v1/completions",
+                                             json=js,
+                                             headers=headers or {}) as resp:
+                            assert resp.status == 200, await resp.text()
+                            return (await resp.json())[
+                                "choices"][0]["text"]
+
+                    ref = await comp(ua, body)
+                    # An integrity-off pull works peer-to-peer (both sides
+                    # speak the pre-integrity dialect)...
+                    got = await comp(ub, body,
+                                     headers={PREFIX_SOURCE_HEADER: ua})
+                    assert got == ref
+                    assert sb.engine.engine.obs.fleet_pulls["ok"] == 1
+                    # ...and the exported stream carries NO integrity
+                    # fields: byte-level the pre-integrity wire format.
+                    async with sess.post(
+                            f"{ua}/internal/fetch_prefix",
+                            json={"prompt_token_ids": prompt,
+                                  "have_tokens": 0}) as resp:
+                        assert resp.status == 200
+                        stream = await resp.read()
+                    dec = PrefixStreamDecoder()
+                    dec.feed(stream)
+                    assert dec.header is not None
+                    assert "page_crc" not in dec.header
+                    assert "frame_crc" not in dec.header
+            finally:
+                for runner in reversed(runners):
+                    await runner.cleanup()
+
+        asyncio.run(scenario())
