@@ -23,6 +23,9 @@ from .tenant_accounting import TenantAccountingSafetyRule
 from .fleet_fetch import FleetFetchBoundaryRule
 from .draft_state import DraftStateBoundaryRule
 from .wire_integrity import WireIntegrityRule
+from .await_atomicity import AwaitAtomicityRule
+from .thread_ownership import ThreadOwnershipRule
+from .lock_discipline import LockDisciplineRule
 
 ALL_RULES = [
     TraceSafetyRule(),
@@ -43,6 +46,9 @@ ALL_RULES = [
     FleetFetchBoundaryRule(),
     DraftStateBoundaryRule(),
     WireIntegrityRule(),
+    AwaitAtomicityRule(),
+    ThreadOwnershipRule(),
+    LockDisciplineRule(),
 ]
 
 

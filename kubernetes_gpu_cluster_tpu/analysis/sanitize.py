@@ -29,7 +29,9 @@ committed) and are guarded statically by KGCT005 instead.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import time
 from typing import Optional
 
 import numpy as np
@@ -41,6 +43,74 @@ class SanitizerError(AssertionError):
 
 def sanitize_enabled() -> bool:
     return os.environ.get("KGCT_SANITIZE", "").strip() not in ("", "0")
+
+
+def interleave_enabled() -> bool:
+    return (os.environ.get("KGCT_SANITIZE_INTERLEAVE", "").strip()
+            not in ("", "0"))
+
+
+def build_interleave_sanitizer() -> Optional["InterleaveSanitizer"]:
+    """AsyncLLMEngine's construction seam: None (zero-cost hooks) unless
+    ``KGCT_SANITIZE_INTERLEAVE=1``; ``KGCT_INTERLEAVE_SEED`` picks the
+    schedule (default 0)."""
+    if not interleave_enabled():
+        return None
+    return InterleaveSanitizer(
+        int(os.environ.get("KGCT_INTERLEAVE_SEED", "0") or "0"))
+
+
+class InterleaveSanitizer:
+    """Deterministic yield-point injection at the sanctioned loop/worker
+    seam crossings — the runtime counterpart of KGCT019–021.
+
+    The static rules prove the await-window/ownership/lock invariants
+    syntactically; this sanitizer makes the chaos tests EXERCISE them:
+    every hook site (request submit, stream relay, worker wake, pre-step)
+    asks :meth:`decide` whether to yield, and the decision is a pure
+    function of ``(seed, site, per-site counter)`` — same seed, same
+    workload ⇒ the same interleaving replays exactly, so a race the
+    rules claim is closed can be hunted at every seeded schedule and a
+    failure reproduces from its seed alone.
+
+    Threading: each site string is touched from exactly ONE thread
+    (``generate.*`` on the event loop, ``worker.*`` on the engine worker
+    thread), so the per-site counters need no lock and the decision
+    sequence per site is deterministic regardless of cross-thread
+    timing. ``trace`` records (site, n, yielded) for test assertions;
+    appends are GIL-atomic.
+
+    Loop-side hooks call :meth:`decide` and ``await asyncio.sleep(0)``
+    themselves (a sanitizer cannot await); worker-side hooks use
+    :meth:`worker_yield`, a bounded ``time.sleep`` that widens the
+    windows the await-atomicity rule polices.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._counters: dict = {}
+        self.trace: list = []     # (site, n, yielded) in decision order
+
+    def decide(self, site: str) -> tuple:
+        """(yielded, delay seconds) for this site's next crossing."""
+        n = self._counters.get(site, 0)
+        self._counters[site] = n + 1
+        h = int.from_bytes(
+            hashlib.blake2b(f"{self.seed}:{site}:{n}".encode(),
+                            digest_size=8).digest(), "big")
+        yielded = (h & 3) == 0            # perturb ~25% of crossings
+        delay = ((h >> 2) & 3) * 2e-4     # 0 / 0.2 / 0.4 / 0.6 ms
+        self.trace.append((site, n, yielded))
+        return yielded, delay
+
+    def worker_yield(self, site: str) -> None:
+        """Worker-thread yield point: sleep long enough for the event
+        loop to run coroutines into any window left open here. Never
+        called under ``_cv`` — sleeping under a loop-contended lock is
+        exactly the bug KGCT021 exists to reject."""
+        yielded, delay = self.decide(site)
+        if yielded:
+            time.sleep(delay if delay > 0 else 1e-4)
 
 
 def build_step_sanitizer(page_size: int) -> Optional["StepSanitizer"]:

@@ -20,6 +20,7 @@ import itertools
 import threading
 from typing import AsyncIterator, Optional
 
+from ..analysis.sanitize import build_interleave_sanitizer
 from ..config import EngineConfig
 from ..engine import LLMEngine, RequestOutput, SamplingParams
 from ..utils import get_logger
@@ -94,6 +95,10 @@ class AsyncLLMEngine:
         # pairs executed between steps, where every engine/scheduler/device
         # touch is single-threaded by construction.
         self._ops: list = []
+        # KGCT_SANITIZE_INTERLEAVE: deterministic seeded yields at the
+        # loop/worker seam crossings (None when off — every hook is one
+        # `is None` test, byte-identical to the sanitizer being absent).
+        self._interleave = build_interleave_sanitizer()
         self._cv = threading.Condition()
         self._shutdown = False
         self._counter = itertools.count()
@@ -194,6 +199,12 @@ class AsyncLLMEngine:
         stream carries only genuinely new tokens. With ``handoff`` set,
         this is the import's fallback rung — a plain re-prefill of the
         prompt alone would re-emit every already-relayed token."""
+        izer = self._interleave
+        if izer is not None and izer.decide("generate.submit")[0]:
+            # Equivalent to the caller being scheduled later: runs before
+            # the reservation consume, so no new await-window opens
+            # between a guard and its claim.
+            await asyncio.sleep(0)
         if request_id in self._reserved:
             # Consume the slot reserve_request_id claimed for us.
             self._reserved.discard(request_id)
@@ -220,6 +231,8 @@ class AsyncLLMEngine:
         try:
             while True:
                 chunk = await queue.get()
+                if izer is not None and izer.decide("generate.stream")[0]:
+                    await asyncio.sleep(0)
                 if isinstance(chunk, Exception):
                     raise chunk
                 yield chunk
@@ -283,6 +296,7 @@ class AsyncLLMEngine:
     # -- worker thread -------------------------------------------------------
 
     def _worker(self) -> None:
+        izer = self._interleave
         while True:
             with self._cv:
                 while not (self._shutdown or self._inbox or self._aborts
@@ -300,6 +314,11 @@ class AsyncLLMEngine:
                             fut.set_exception(
                                 RuntimeError("engine shut down"))
                     return
+            if izer is not None:
+                # Post-wake, OUTSIDE _cv (a sleep under a loop-contended
+                # lock is the KGCT021 bug class itself): widen the window
+                # between inbox capture and ops/admission/step.
+                izer.worker_yield("worker.wake")
             for fn, fut in ops:
                 try:
                     result = fn(self.engine)
@@ -411,6 +430,10 @@ class AsyncLLMEngine:
                 except ValueError as e:   # oversized prompt etc.
                     self._post_exc(rid, e)
             if self.engine.has_unfinished_requests():
+                if izer is not None:
+                    # Between admission and dispatch: the window a loop-
+                    # side engine-state read (KGCT020) would race.
+                    izer.worker_yield("worker.step")
                 wd = self.watchdog
                 if wd is not None:
                     wd.arm()

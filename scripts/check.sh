@@ -4,9 +4,15 @@
 # an image can never ship lint-dirty or test-broken code; run it standalone
 # before any push for the same signal.
 #
-# Usage: scripts/check.sh [--lint-only]
-#   --lint-only    skip the tier-1 pytest run (seconds instead of minutes;
-#                  the lint gate alone still blocks every rule violation)
+# Usage: scripts/check.sh [--lint-only] [--changed GIT_REF]
+#   --lint-only        skip the tier-1 pytest run (seconds instead of
+#                      minutes; the lint gate alone still blocks every
+#                      rule violation)
+#   --changed GIT_REF  lint only .py files touched vs GIT_REF (kgct-lint
+#                      --changed): the pre-commit fast path, same rules
+#
+# Artifacts: the SARIF findings document lands next to the tier-1 log
+# (/tmp/_kgct_check.sarif) so CI can upload it for PR annotation.
 #
 # Exit codes: 0 clean; non-zero on the first failing stage (pipefail —
 # a tee'd pytest failure cannot launder its exit status).
@@ -15,16 +21,23 @@ set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "${REPO_ROOT}"
 LINT_ONLY=0
+CHANGED_REF=""
 
 while [[ $# -gt 0 ]]; do
   case "$1" in
     --lint-only) LINT_ONLY=1; shift ;;
+    --changed) CHANGED_REF="${2:?--changed needs a git ref}"; shift 2 ;;
     *) echo "unknown arg: $1" >&2; exit 2 ;;
   esac
 done
 
 echo ">> kgct-lint (empty-baseline gate)"
-python -m kubernetes_gpu_cluster_tpu.analysis.cli kubernetes_gpu_cluster_tpu bench.py
+rm -f /tmp/_kgct_check.sarif
+LINT_ARGS=(kubernetes_gpu_cluster_tpu bench.py --sarif /tmp/_kgct_check.sarif)
+if [[ -n "${CHANGED_REF}" ]]; then
+  LINT_ARGS+=(--changed "${CHANGED_REF}")
+fi
+python -m kubernetes_gpu_cluster_tpu.analysis.cli "${LINT_ARGS[@]}"
 
 if [[ "${LINT_ONLY}" == 1 ]]; then
   echo ">> check.sh: lint clean (tier-1 skipped via --lint-only)"

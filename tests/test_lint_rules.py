@@ -1102,3 +1102,280 @@ class TestWireIntegrity:  # KGCT018
             def commit(self, handle):
                 self.commit_prefix_import(handle)
         """, "KGCT018", relpath="engine/engine.py") == []
+
+
+class TestAwaitAtomicity:  # KGCT019
+    def test_guard_await_claim_fires(self):
+        found = lint("""
+            class H:
+                async def admit(self, rid, req):
+                    if rid not in self._active:
+                        ok = await self.check(req)
+                        self._active[rid] = ok
+        """, "KGCT019", relpath="serving/api_server.py")
+        assert len(found) == 1 and "_active" in found[0].message
+
+    def test_mutator_claim_after_await_fires(self):
+        found = lint("""
+            class H:
+                async def track(self, rid):
+                    if rid not in self._mid_stream:
+                        await self._announce(rid)
+                        self._mid_stream.add(rid)
+        """, "KGCT019", relpath="serving/api_server.py")
+        assert len(found) == 1 and "_mid_stream" in found[0].message
+
+    def test_is_none_guard_with_await_in_claim_fires(self):
+        # The double-create shape: both callers pass `is None`, both await
+        # the constructor, the second overwrites (and leaks) the first.
+        found = lint("""
+            class H:
+                async def session(self):
+                    if self._http is None:
+                        self._http = await make_session()
+                    return self._http
+        """, "KGCT019", relpath="serving/api_server.py")
+        assert len(found) == 1
+
+    def test_no_await_between_guard_and_claim_silent(self):
+        # Check-then-act with nothing interleaved IS atomic on the loop —
+        # the real _pull_prefix lazy-session shape.
+        assert lint("""
+            class H:
+                async def session(self, req):
+                    if self._http is None:
+                        self._http = make_session()
+                    await self._http.post(req)
+        """, "KGCT019", relpath="serving/api_server.py") == []
+
+    def test_while_recheck_guard_silent(self):
+        # A while re-evaluates its condition after every await: the
+        # condition-variable idiom, no stale-guard window.
+        assert lint("""
+            class H:
+                async def wait_slot(self, rid):
+                    while rid in self._active:
+                        await asyncio.sleep(0)
+                    self._active[rid] = True
+        """, "KGCT019", relpath="serving/api_server.py") == []
+
+    def test_sync_reservation_seam_silent(self):
+        # The declared atomic-reservation seam: a sync def cannot suspend,
+        # so check-and-claim cannot race itself on the loop.
+        assert lint("""
+            class E:
+                def reserve_request_id(self, rid):
+                    if rid in self._queues:
+                        return False
+                    self._queues[rid] = make_queue()
+                    self._reserved.add(rid)
+                    return True
+        """, "KGCT019", relpath="serving/async_engine.py") == []
+
+    def test_outside_serving_silent(self):
+        assert lint("""
+            class H:
+                async def admit(self, rid, req):
+                    if rid not in self._active:
+                        ok = await self.check(req)
+                        self._active[rid] = ok
+        """, "KGCT019", relpath="engine/fake.py") == []
+
+
+class TestThreadOwnership:  # KGCT020
+    def test_iteration_through_alias_fires(self):
+        found = lint("""
+            class S:
+                async def scrape(self):
+                    sched = self.engine.engine.scheduler
+                    return [r.request_id for r in sched.running]
+        """, "KGCT020", relpath="serving/api_server.py")
+        assert len(found) == 1 and "iterates" in found[0].message
+
+    def test_method_call_on_owned_state_fires(self):
+        found = lint("""
+            class S:
+                async def compact(self):
+                    self.engine.engine.scheduler.preempt_lowest()
+        """, "KGCT020", relpath="serving/api_server.py")
+        assert len(found) == 1 and "calls a method" in found[0].message
+
+    def test_subscript_fires(self):
+        found = lint("""
+            class S:
+                async def peek(self):
+                    eng = self.engine.engine
+                    return eng.scheduler.waiting[0]
+        """, "KGCT020", relpath="serving/api_server.py")
+        assert len(found) == 1 and "subscripts" in found[0].message
+
+    def test_rebind_fires(self):
+        found = lint("""
+            class S:
+                async def reset(self):
+                    self.engine.engine.scheduler = None
+        """, "KGCT020", relpath="serving/api_server.py")
+        assert len(found) == 1 and "rebinds" in found[0].message
+
+    def test_gil_atomic_snapshots_silent(self):
+        # The /healthz queue-depth gauges: len()/truthiness/is-None read
+        # one reference atomically and copy nothing mutable.
+        assert lint("""
+            class S:
+                async def health(self):
+                    sched = self.engine.engine.scheduler
+                    depth = len(sched.waiting) + len(sched.running)
+                    ok = bool(depth) if sched.swapped is None else True
+                    if sched.waiting:
+                        depth += 1
+                    return depth
+        """, "KGCT020", relpath="serving/api_server.py") == []
+
+    def test_worker_op_seam_silent(self):
+        assert lint("""
+            class S:
+                async def depth(self):
+                    return await self.engine.run_in_worker(
+                        lambda e: [r.request_id for r in e.scheduler.running])
+        """, "KGCT020", relpath="serving/api_server.py") == []
+
+    def test_sync_setup_silent(self):
+        # __init__ runs before the worker thread exists.
+        assert lint("""
+            class S:
+                def __init__(self, engine):
+                    kv = engine.engine.kv_cache
+                    self.pages = kv.num_pages()
+        """, "KGCT020", relpath="serving/api_server.py") == []
+
+    def test_async_engine_module_exempt(self):
+        assert lint("""
+            class A:
+                async def drain(self):
+                    self.engine.scheduler.abort_all()
+        """, "KGCT020", relpath="serving/async_engine.py") == []
+
+    def test_outside_serving_silent(self):
+        assert lint("""
+            class S:
+                async def scrape(self):
+                    return [r for r in self.engine.engine.scheduler.running]
+        """, "KGCT020", relpath="engine/fake.py") == []
+
+
+class TestLockDiscipline:  # KGCT021
+    def test_await_under_lock_fires(self):
+        found = lint("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                async def flush(self):
+                    with self._lock:
+                        await self._send()
+        """, "KGCT021", relpath="serving/api_server.py")
+        assert len(found) == 1 and "await while holding" in found[0].message
+
+    def test_blocking_under_loop_contended_lock_fires(self):
+        # The indirect stall: the worker sleeps under a lock an async
+        # handler also acquires — the handler blocks the WHOLE loop in
+        # acquire() for the sleep's duration.
+        found = lint("""
+            import threading, time
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                async def touch(self):
+                    with self._lock:
+                        self.n += 1
+
+                def worker_side(self):
+                    with self._lock:
+                        time.sleep(1.0)
+        """, "KGCT021", relpath="serving/api_server.py")
+        assert len(found) == 1 and "time.sleep" in found[0].message
+
+    def test_cross_boundary_lock_fires_at_both_sites(self):
+        found = lint("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._lock:
+                        self.q.append(1)
+
+                async def submit(self, x):
+                    with self._lock:
+                        self.q.append(x)
+        """, "KGCT021", relpath="serving/api_server.py")
+        assert len(found) == 2
+        assert all("both sides" in f.message for f in found)
+
+    def test_worker_only_lock_over_blocking_send_silent(self):
+        # The directive leader's shape: the lock serializes the worker and
+        # heartbeat threads; no event-loop code ever contends for it, so
+        # blocking sends under it stall nobody's loop.
+        assert lint("""
+            import threading, time
+
+            class L:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._lock:
+                        time.sleep(0.1)
+
+                def heartbeat(self):
+                    with self._lock:
+                        time.sleep(0.1)
+        """, "KGCT021", relpath="serving/multihost.py") == []
+
+    def test_handshake_module_exempt_from_cross_boundary(self):
+        # AsyncLLMEngine._cv IS the sanctioned loop/worker handshake.
+        assert lint("""
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._thread = threading.Thread(target=self._worker)
+
+                def _worker(self):
+                    with self._cv:
+                        self._cv.wait()
+
+                async def generate(self, item):
+                    with self._cv:
+                        self._inbox.append(item)
+                        self._cv.notify()
+        """, "KGCT021", relpath="serving/async_engine.py") == []
+
+    def test_condition_wait_not_blocking_set(self):
+        # wait/wait_for RELEASE the lock while waiting — the handshake
+        # idiom is not a blocking call under the lock.
+        found = lint("""
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                async def poke(self):
+                    with self._cv:
+                        self._cv.notify()
+
+                def worker(self):
+                    with self._cv:
+                        self._cv.wait_for(lambda: self.ready)
+        """, "KGCT021", relpath="serving/fake.py")
+        assert found == []
